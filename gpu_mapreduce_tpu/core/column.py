@@ -1,0 +1,201 @@
+"""Column types backing KV/KMV datasets.
+
+The reference packs every key/value into byte-aligned pages
+(``src/keyvalue.cpp:343-392``: ``[keybytes][valuebytes][key pad][value pad]``).
+A TPU wants fixed-width lanes, so we go columnar instead (SURVEY.md §7):
+
+* :class:`DenseColumn` — fixed-width numeric data, shape ``[n]`` or
+  ``[n, w]``; lives as a ``numpy`` or ``jax`` array and moves between the two
+  lazily.  This is the fast path: every oink graph workload uses fixed-width
+  struct keys/values (``oink/typedefs.h:22-40`` VERTEX=uint64, EDGE={vi,vj},
+  WEIGHT=double).
+* :class:`BytesColumn` — arbitrary per-row byte strings (object ndarray),
+  host-only; the analogue of the reference's variable-length byte path.  It
+  can be *interned* to a u64 DenseColumn plus a host-side id→bytes dictionary
+  so shuffles/group-bys run on device (SURVEY.md §7 "hard parts").
+
+Both support the minimal op set the runtime needs: ``take`` (gather by row
+index), ``concat``, ``slice``, and conversion to/from host.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.hash import hash_bytes64
+
+ArrayLike = Union[np.ndarray, jax.Array]
+
+
+def _is_device(arr) -> bool:
+    return isinstance(arr, jax.Array)
+
+
+class Column:
+    """Abstract base: a sequence of n fixed-arity rows."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def take(self, idx) -> "Column":
+        raise NotImplementedError
+
+    def slice(self, start: int, stop: int) -> "Column":
+        raise NotImplementedError
+
+    def to_host(self) -> "Column":
+        raise NotImplementedError
+
+    def nbytes(self) -> int:
+        raise NotImplementedError
+
+    def tolist(self) -> list:
+        """Rows as python scalars/tuples/bytes (for host callbacks/printing)."""
+        raise NotImplementedError
+
+
+class DenseColumn(Column):
+    __slots__ = ("data",)
+
+    def __init__(self, data: ArrayLike):
+        if not (_is_device(data) or isinstance(data, np.ndarray)):
+            data = np.asarray(data)
+        if data.ndim == 0:
+            data = data.reshape(1)
+        assert data.ndim in (1, 2), f"column rank must be 1 or 2, got {data.ndim}"
+        self.data = data
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def width(self) -> int:
+        return 1 if self.data.ndim == 1 else int(self.data.shape[1])
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def device(self) -> "DenseColumn":
+        return self if _is_device(self.data) else DenseColumn(jnp.asarray(self.data))
+
+    def to_host(self) -> "DenseColumn":
+        return DenseColumn(np.asarray(self.data)) if _is_device(self.data) else self
+
+    def take(self, idx) -> "DenseColumn":
+        xp = jnp if _is_device(self.data) or _is_device(idx) else np
+        return DenseColumn(xp.asarray(self.data)[xp.asarray(idx)])
+
+    def slice(self, start: int, stop: int) -> "DenseColumn":
+        return DenseColumn(self.data[start:stop])
+
+    def nbytes(self) -> int:
+        return int(self.data.size) * self.data.dtype.itemsize
+
+    def tolist(self) -> list:
+        host = np.asarray(self.data)
+        if host.ndim == 1:
+            return host.tolist()
+        return [tuple(row) for row in host.tolist()]
+
+    def __repr__(self):
+        where = "dev" if _is_device(self.data) else "host"
+        return f"DenseColumn<{self.data.dtype}{list(self.data.shape)}@{where}>"
+
+
+class BytesColumn(Column):
+    """Host column of arbitrary byte strings (reference's byte-packed path)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: Sequence[bytes]):
+        if isinstance(data, np.ndarray) and data.dtype == object:
+            self.data = data
+        else:
+            arr = np.empty(len(data), dtype=object)
+            for i, x in enumerate(data):
+                arr[i] = x if isinstance(x, bytes) else bytes(x)
+            self.data = arr
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    def to_host(self) -> "BytesColumn":
+        return self
+
+    def take(self, idx) -> "BytesColumn":
+        return BytesColumn(self.data[np.asarray(idx)])
+
+    def slice(self, start: int, stop: int) -> "BytesColumn":
+        return BytesColumn(self.data[start:stop])
+
+    def nbytes(self) -> int:
+        return int(sum(len(x) for x in self.data))
+
+    def tolist(self) -> list:
+        return self.data.tolist()
+
+    def intern(self) -> tuple:
+        """Map byte strings to u64 ids for device-side shuffling/grouping.
+
+        Returns ``(DenseColumn[uint64], {id: bytes})``.  Raises on a 64-bit
+        collision between distinct strings (probability ~n^2/2^64)."""
+        ids = np.empty(len(self.data), dtype=np.uint64)
+        table: Dict[int, bytes] = {}
+        for i, s in enumerate(self.data):
+            h = hash_bytes64(s)
+            prev = table.get(h)
+            if prev is not None and prev != s:
+                raise ValueError("64-bit intern collision between %r and %r" % (prev, s))
+            table[h] = s
+            ids[i] = h
+        return DenseColumn(ids), table
+
+    def __repr__(self):
+        return f"BytesColumn<n={len(self)}>"
+
+
+def concat(cols: List[Column]) -> Column:
+    cols = [c for c in cols if len(c) > 0] or cols[:1]
+    if len(cols) == 1:
+        return cols[0]
+    first = cols[0]
+    if isinstance(first, BytesColumn):
+        assert all(isinstance(c, BytesColumn) for c in cols)
+        return BytesColumn(np.concatenate([c.data for c in cols]))
+    assert all(isinstance(c, DenseColumn) for c in cols)
+    if any(_is_device(c.data) for c in cols):
+        return DenseColumn(jnp.concatenate([jnp.asarray(c.data) for c in cols], axis=0))
+    return DenseColumn(np.concatenate([c.data for c in cols], axis=0))
+
+
+def as_column(x) -> Column:
+    """Coerce user-supplied data to a Column.
+
+    bytes/str sequences → BytesColumn; numeric arrays/sequences → DenseColumn.
+    """
+    if isinstance(x, Column):
+        return x
+    if isinstance(x, (bytes, str)):
+        return BytesColumn([x if isinstance(x, bytes) else x.encode()])
+    if isinstance(x, np.ndarray) and x.dtype == object:
+        return BytesColumn(x)
+    if _is_device(x) or isinstance(x, np.ndarray):
+        return DenseColumn(x)
+    if isinstance(x, (list, tuple)) and len(x) > 0 and isinstance(x[0], (bytes, str)):
+        return BytesColumn([s if isinstance(s, bytes) else s.encode() for s in x])
+    return DenseColumn(np.asarray(x))
+
+
+def empty_like(col: Column) -> Column:
+    if isinstance(col, BytesColumn):
+        return BytesColumn([])
+    data = col.data
+    shape = (0,) if data.ndim == 1 else (0, data.shape[1])
+    if _is_device(data):
+        return DenseColumn(jnp.zeros(shape, dtype=data.dtype))
+    return DenseColumn(np.zeros(shape, dtype=data.dtype))

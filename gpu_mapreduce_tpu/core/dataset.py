@@ -1,0 +1,294 @@
+"""KeyValue / KeyMultiValue datasets: frame lists with an add/complete
+protocol and host-DRAM/disk spill.
+
+This is the TPU re-design of the reference's paged containers:
+
+* ``KeyValue`` (``src/keyvalue.{h,cpp}``) — append-only byte-packed pairs in
+  64 MB pages, spilling page-at-a-time to ``fpath/mrmpi.kv.*`` files
+  (``src/mapreduce.cpp:3187-3205``).  Here: an append buffer of python rows
+  and/or columnar batches that ``complete()`` consolidates into
+  :class:`~..core.frame.KVFrame` frames.  Frames beyond the ``maxpage``
+  HBM budget live as host numpy; with ``outofcore=1`` they move to ``.npz``
+  spill files (same naming scheme), loaded back on demand — the
+  ``request_page``/``write_page`` protocol (``src/keyvalue.cpp:277-308,
+  688-756``) becomes :meth:`KeyValue.frames` iteration.
+* ``KeyMultiValue`` (``src/keymultivalue.{h,cpp}``) — grouped frames.
+
+``add()`` accepts scalars (host path, like kv->add per pair) and
+``add_batch()`` accepts whole columns (the vectorised path every kernel op
+uses).  ``complete()`` finalises and computes the global pair count, the
+analogue of the Allreduce in ``KeyValue::complete`` (src/keyvalue.cpp:216-255).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .column import BytesColumn, Column, DenseColumn, as_column, concat
+from .frame import KMVFrame, KVFrame
+from .runtime import Counters, Error, Settings
+
+_INSTANCE_COUNTER = [0]
+
+
+def _next_file_id() -> int:
+    _INSTANCE_COUNTER[0] += 1
+    return _INSTANCE_COUNTER[0]
+
+
+class _Spilled:
+    """A frame parked in an .npz spill file (reference write_page/read_page,
+    src/keyvalue.cpp:688-756; naming src/mapreduce.cpp:3187-3205)."""
+
+    __slots__ = ("path", "n", "bytes_")
+
+    def __init__(self, path: str, n: int, bytes_: int):
+        self.path = path
+        self.n = n
+        self.bytes_ = bytes_
+
+    def load(self, counters: Counters) -> KVFrame:
+        with np.load(self.path, allow_pickle=True) as z:
+            key = _col_from_npz(z, "k")
+            value = _col_from_npz(z, "v")
+        counters.rsize += self.bytes_
+        return KVFrame(key, value)
+
+
+def _col_to_npz(col: Column, prefix: str, out: dict):
+    if isinstance(col, BytesColumn):
+        out[prefix + "_obj"] = col.data
+    else:
+        out[prefix + "_arr"] = np.asarray(col.data)
+
+
+def _col_from_npz(z, prefix: str) -> Column:
+    if prefix + "_obj" in z:
+        return BytesColumn(z[prefix + "_obj"])
+    return DenseColumn(z[prefix + "_arr"])
+
+
+class KeyValue:
+    """Append-only KV dataset (one shard's worth on the serial backend; the
+    mesh backend stores per-shard device arrays through the same interface)."""
+
+    def __init__(self, settings: Settings, error: Error, counters: Counters,
+                 name: str = "kv"):
+        self.settings = settings
+        self.error = error
+        self.counters = counters
+        self.name = name
+        self.fileid = _next_file_id()
+        self._buf_k: list = []           # scalar append buffer
+        self._buf_v: list = []
+        self._batches: List[KVFrame] = []  # columnar append buffer
+        self._frames: List[object] = []    # KVFrame | _Spilled
+        self.nkv = 0
+        self.complete_done = False
+
+    # -- add protocol ------------------------------------------------------
+
+    def add(self, key, value):
+        """Add one pair (reference kv->add(key,keybytes,value,valuebytes),
+        src/keyvalue.cpp:343-392)."""
+        self._buf_k.append(key)
+        self._buf_v.append(value)
+        if len(self._buf_k) >= 1 << 20:
+            self._flush_scalars()
+
+    def add_batch(self, keys, values):
+        """Add a batch of pairs as columns/arrays (the vectorised fast path —
+        replaces the reference's chunked bulk add, src/keyvalue.cpp:526-605)."""
+        self._flush_scalars()  # preserve add order when interleaved with add()
+        frame = KVFrame(as_column(keys), as_column(values))
+        if len(frame):
+            self._batches.append(frame)
+
+    def add_kv(self, other: "KeyValue"):
+        """Append another KV's pairs (reference MapReduce::add,
+        src/mapreduce.cpp:348-374)."""
+        for fr in other.frames():
+            self._batches.append(fr)
+
+    def _flush_scalars(self):
+        if not self._buf_k:
+            return
+        k = _coerce_rows(self._buf_k)
+        v = _coerce_rows(self._buf_v)
+        self._batches.append(KVFrame(k, v))
+        self._buf_k, self._buf_v = [], []
+
+    # -- completion --------------------------------------------------------
+
+    def complete(self):
+        """Finalise: consolidate buffers into budget-sized frames
+        (reference KeyValue::complete, src/keyvalue.cpp:216-255)."""
+        self._flush_scalars()
+        if self._batches:
+            merged = _merge_frames(self._batches)
+            self._batches = []
+            for fr in _split_to_budget(merged, self.settings):
+                self._push_frame(fr)
+        self.nkv = sum(self._frame_n(f) for f in self._frames)
+        self.complete_done = True
+        return self.nkv
+
+    def append(self):
+        """Re-open a completed KV for more adds (reference KeyValue::append,
+        src/keyvalue.cpp:185-209)."""
+        self.complete_done = False
+
+    def _frame_n(self, f) -> int:
+        return f.n if isinstance(f, _Spilled) else len(f)
+
+    def _push_frame(self, fr: KVFrame):
+        budget = self.settings.maxpage * self.settings.memsize * (1 << 20)
+        if (self.settings.outofcore == 1 and budget
+                and self._resident_bytes() + fr.nbytes() > budget):
+            self._spill(fr)
+        else:
+            self._frames.append(fr)
+            self.counters.mem(fr.nbytes())
+
+    def _resident_bytes(self) -> int:
+        return sum(f.nbytes() for f in self._frames if isinstance(f, KVFrame))
+
+    def _spill(self, fr: KVFrame):
+        os.makedirs(self.settings.fpath, exist_ok=True)
+        path = os.path.join(
+            self.settings.fpath,
+            f"mrtpu.{self.name}.{self.fileid}.{len(self._frames)}.npz")
+        payload: dict = {}
+        _col_to_npz(fr.key.to_host(), "k", payload)
+        _col_to_npz(fr.value.to_host(), "v", payload)
+        np.savez(path, **payload)
+        nb = fr.nbytes()
+        self.counters.wsize += nb
+        self._frames.append(_Spilled(path, len(fr), nb))
+
+    # -- read protocol -----------------------------------------------------
+
+    @property
+    def nframes(self) -> int:
+        return len(self._frames)
+
+    def frames(self) -> Iterator[KVFrame]:
+        """Stream frames (reference request_info/request_page cursor,
+        src/keyvalue.cpp:277-308)."""
+        for f in self._frames:
+            yield f.load(self.counters) if isinstance(f, _Spilled) else f
+
+    def one_frame(self) -> KVFrame:
+        """Whole dataset as a single frame (in-core fast path)."""
+        frames = list(self.frames())
+        if not frames:
+            from .frame import empty_kv
+            return empty_kv()
+        return _merge_frames(frames)
+
+    def nbytes(self) -> int:
+        return sum(f.bytes_ if isinstance(f, _Spilled) else f.nbytes()
+                   for f in self._frames)
+
+    def free(self):
+        for f in self._frames:
+            if isinstance(f, _Spilled):
+                try:
+                    os.remove(f.path)
+                except OSError:
+                    pass
+            else:
+                self.counters.mem(-f.nbytes())
+        self._frames = []
+        self._batches = []
+        self.nkv = 0
+
+
+class KeyMultiValue:
+    """Grouped dataset: list of KMVFrames (one per source frame batch)."""
+
+    def __init__(self, settings: Settings, error: Error, counters: Counters):
+        self.settings = settings
+        self.error = error
+        self.counters = counters
+        self._frames: List[KMVFrame] = []
+        self.nkmv = 0
+        self.nvalues = 0
+
+    def push(self, fr: KMVFrame):
+        self._frames.append(fr)
+        self.counters.mem(fr.nbytes())
+
+    def complete(self):
+        self.nkmv = sum(len(f) for f in self._frames)
+        self.nvalues = sum(f.nvalues_total for f in self._frames)
+        return self.nkmv
+
+    @property
+    def nframes(self) -> int:
+        return len(self._frames)
+
+    def frames(self) -> Iterator[KMVFrame]:
+        yield from self._frames
+
+    def one_frame(self) -> KMVFrame:
+        frames = self._frames
+        if len(frames) == 1:
+            return frames[0]
+        if not frames:
+            return KMVFrame(DenseColumn(np.zeros(0, np.uint64)),
+                            np.zeros(0, np.int64), np.zeros(1, np.int64),
+                            DenseColumn(np.zeros(0, np.uint64)))
+        key = concat([f.key for f in frames])
+        values = concat([f.values for f in frames])
+        nvalues = np.concatenate([f.nvalues for f in frames])
+        offsets = np.concatenate([[0], np.cumsum(nvalues)]).astype(np.int64)
+        return KMVFrame(key, nvalues, offsets, values)
+
+    def nbytes(self) -> int:
+        return sum(f.nbytes() for f in self._frames)
+
+    def free(self):
+        for f in self._frames:
+            self.counters.mem(-f.nbytes())
+        self._frames = []
+        self.nkmv = 0
+        self.nvalues = 0
+
+
+# ---------------------------------------------------------------------------
+
+def _coerce_rows(rows: list) -> Column:
+    """Turn a python append buffer into a column: bytes→BytesColumn,
+    numbers/tuples→DenseColumn."""
+    first = rows[0]
+    if isinstance(first, (bytes, str, bytearray)):
+        return BytesColumn([r if isinstance(r, bytes) else
+                            (r.encode() if isinstance(r, str) else bytes(r))
+                            for r in rows])
+    if first is None:
+        return DenseColumn(np.zeros(len(rows), dtype=np.uint8))
+    arr = np.asarray(rows)
+    if arr.dtype == object:
+        raise TypeError("mixed-type rows in KV add buffer")
+    return DenseColumn(arr)
+
+
+def _merge_frames(frames: Sequence[KVFrame]) -> KVFrame:
+    if len(frames) == 1:
+        return frames[0]
+    return KVFrame(concat([f.key for f in frames]),
+                   concat([f.value for f in frames]))
+
+
+def _split_to_budget(fr: KVFrame, settings: Settings) -> List[KVFrame]:
+    """Split a frame to the memsize budget (a reference page boundary)."""
+    limit = settings.memsize * (1 << 20)
+    n = len(fr)
+    if n == 0 or fr.nbytes() <= limit:
+        return [fr]
+    rows_per = max(1, int(n * limit / fr.nbytes()))
+    return [fr.slice(s, min(s + rows_per, n)) for s in range(0, n, rows_per)]

@@ -1,0 +1,135 @@
+"""KV and KMV frames — the in-memory unit of data.
+
+A *frame* is the TPU-native replacement for one reference "page"
+(``src/keyvalue.h:83-92``): an immutable batch of key/value pairs (KVFrame) or
+grouped key/multivalue pairs (KMVFrame).  A dataset (``KeyValue`` /
+``KeyMultiValue`` in ``dataset.py``) is a list of frames, exactly as a
+reference KV is a list of pages — frames past the memory budget spill to host
+DRAM (and optionally disk) instead of staying in HBM.
+
+KMV layout: the reference packs ``[nvalue][keybytes][mvbytes][valuesizes[]]
+[key][values]`` per group (``src/keymultivalue.h:23-196``).  Columnar
+equivalent: unique keys ``[g]``, per-group counts ``[g]``, exclusive offsets
+``[g+1]``, and a flat value column ``[n]`` whose rows are grouped
+contiguously.  A group larger than one frame's budget is the reference's
+"extended"/multi-block KMV (``src/keymultivalue.cpp:1219-1350``); here any
+group is already contiguous so blocks are just sub-slices — see
+``KMVFrame.blocks_of``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .column import BytesColumn, Column, DenseColumn, as_column
+
+
+class KVFrame:
+    """Immutable batch of (key, value) pairs."""
+
+    __slots__ = ("key", "value")
+
+    def __init__(self, key: Column, value: Column):
+        key = as_column(key)
+        value = as_column(value)
+        assert len(key) == len(value), (len(key), len(value))
+        self.key = key
+        self.value = value
+
+    def __len__(self) -> int:
+        return len(self.key)
+
+    @property
+    def nkv(self) -> int:
+        return len(self.key)
+
+    def nbytes(self) -> int:
+        return self.key.nbytes() + self.value.nbytes()
+
+    def to_host(self) -> "KVFrame":
+        return KVFrame(self.key.to_host(), self.value.to_host())
+
+    def take(self, idx) -> "KVFrame":
+        return KVFrame(self.key.take(idx), self.value.take(idx))
+
+    def slice(self, start: int, stop: int) -> "KVFrame":
+        return KVFrame(self.key.slice(start, stop), self.value.slice(start, stop))
+
+    def pairs(self) -> Iterator[Tuple[object, object]]:
+        """Host iteration as python scalars — the per-pair callback view
+        (what the reference hands to appmap/appreduce callbacks)."""
+        yield from zip(self.key.tolist(), self.value.tolist())
+
+    def is_dense(self) -> bool:
+        return isinstance(self.key, DenseColumn) and isinstance(self.value, DenseColumn)
+
+    def __repr__(self):
+        return f"KVFrame(n={len(self)}, key={self.key!r}, value={self.value!r})"
+
+
+class KMVFrame:
+    """Immutable batch of (key, multivalue) groups.
+
+    ``offsets`` has length g+1; group i's values are
+    ``values[offsets[i]:offsets[i+1]]``.
+    """
+
+    __slots__ = ("key", "nvalues", "offsets", "values")
+
+    def __init__(self, key: Column, nvalues, offsets, values: Column):
+        self.key = as_column(key)
+        self.nvalues = np.asarray(nvalues, dtype=np.int64)
+        self.offsets = np.asarray(offsets, dtype=np.int64)
+        self.values = as_column(values)
+        assert len(self.offsets) == len(self.key) + 1
+
+    def __len__(self) -> int:
+        return len(self.key)
+
+    @property
+    def nkmv(self) -> int:
+        return len(self.key)
+
+    @property
+    def nvalues_total(self) -> int:
+        return len(self.values)
+
+    def nbytes(self) -> int:
+        return self.key.nbytes() + self.values.nbytes() + self.nvalues.nbytes
+
+    def to_host(self) -> "KMVFrame":
+        return KMVFrame(self.key.to_host(), self.nvalues, self.offsets,
+                        self.values.to_host())
+
+    def group_values(self, i: int) -> Column:
+        return self.values.slice(int(self.offsets[i]), int(self.offsets[i + 1]))
+
+    def groups(self) -> Iterator[Tuple[object, list]]:
+        """Host iteration: (key, [values]) per group — the appreduce view
+        (reference src/mapreduce.cpp:1804-1849)."""
+        keys = self.key.tolist()
+        vals = self.values.tolist()
+        for i, k in enumerate(keys):
+            yield k, vals[int(self.offsets[i]):int(self.offsets[i + 1])]
+
+    def blocks_of(self, i: int, block_rows: int) -> Iterator[Column]:
+        """Iterate one group's values in blocks of ≤ block_rows rows — the
+        multi-block KMV API (reference multivalue_blocks()/multivalue_block(),
+        src/mapreduce.cpp:1874-1925, doc/Technical.txt:316-320)."""
+        start, stop = int(self.offsets[i]), int(self.offsets[i + 1])
+        for s in range(start, stop, block_rows):
+            yield self.values.slice(s, min(s + block_rows, stop))
+
+    def is_dense(self) -> bool:
+        return isinstance(self.key, DenseColumn) and isinstance(self.values, DenseColumn)
+
+    def __repr__(self):
+        return (f"KMVFrame(g={len(self)}, n={self.nvalues_total}, "
+                f"key={self.key!r}, values={self.values!r})")
+
+
+def empty_kv() -> KVFrame:
+    return KVFrame(DenseColumn(np.zeros(0, np.uint64)),
+                   DenseColumn(np.zeros(0, np.uint64)))

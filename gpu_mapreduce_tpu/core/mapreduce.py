@@ -1,0 +1,605 @@
+"""The MapReduce class — the ~30-method op algebra of the reference
+(``src/mapreduce.h:59-131``), re-designed TPU-first.
+
+Semantics follow ``doc/Interface_c++.txt`` and the call stacks in SURVEY.md
+§3.  Key differences from the reference, by design (SURVEY.md §7):
+
+* Data is columnar (frames of dense arrays / byte strings), not byte-packed
+  pages.  Every op has a vectorised *batch* path (callbacks receive whole
+  columns, run jitted on device) next to the per-pair *host* path (callbacks
+  receive python scalars — the reference's serial-callback model, kept for
+  parity and arbitrary-object support like python/mrmpi.py's pickled KVs).
+* Parallelism is a pluggable backend: the default :class:`SerialBackend`
+  is the analogue of the reference's mpistubs/ serial MPI (1-proc semantics,
+  ``mpistubs/mpi.cpp:244-395``); the mesh backend (``parallel/``) runs the
+  same ops sharded over a ``jax.sharding.Mesh`` with ICI collectives.
+* ``aggregate()`` early-outs with one proc exactly like the reference
+  (``src/mapreduce.cpp:403-406``).
+
+Every mutating op returns the *global* pair count, like the reference's
+MPI_Allreduce'd returns (``src/mapreduce.cpp:557-558``).
+"""
+
+from __future__ import annotations
+
+import copy as _copymod
+import sys
+import time
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..ops.segment import group_frame
+from ..ops.sort import argsort_column
+from ..utils.io import file_chunks, findfiles
+from .column import BytesColumn, Column, DenseColumn, as_column, concat
+from .dataset import KeyMultiValue, KeyValue
+from .frame import KMVFrame, KVFrame
+from .runtime import Counters, Error, MRError, Settings, Timer, global_counters
+
+
+class SerialBackend:
+    """1-proc backend: all distributed ops are local no-ops or renames.
+
+    This is the moral equivalent of linking against ``mpistubs/`` — the
+    reference's complete single-process MPI fake (``mpistubs/mpi.cpp``):
+    the same program text runs serial or parallel unchanged."""
+
+    nprocs = 1
+    me = 0
+
+    def aggregate(self, mr: "MapReduce", hash_fn) -> None:
+        return  # nprocs==1 early-out, src/mapreduce.cpp:403-406
+
+    def gather(self, mr: "MapReduce", nprocs: int) -> None:
+        return
+
+    def broadcast(self, mr: "MapReduce", root: int) -> None:
+        return
+
+    def allreduce_sum(self, x):
+        return x
+
+
+class MapReduce:
+    """One MapReduce object owns at most one KV and/or one KMV
+    (reference src/mapreduce.h:43-44)."""
+
+    def __init__(self, comm=None, **settings):
+        self.error = Error()
+        self.settings = Settings(**settings)
+        self.settings.validate(self.error)
+        self.counters = global_counters()
+        if comm is None or comm == 1 or (isinstance(comm, int)):
+            self.backend = SerialBackend()
+        else:
+            # a jax.sharding.Mesh → distributed backend (parallel/)
+            from ..parallel.backend import MeshBackend
+            self.backend = MeshBackend(comm)
+        self.kv: Optional[KeyValue] = None
+        self.kmv: Optional[KeyMultiValue] = None
+        self._open = False
+        self._last_stats: dict = {}
+
+    # ------------------------------------------------------------------
+    # settings passthrough (reference exposes them as public members)
+    # ------------------------------------------------------------------
+    def __getattr__(self, name):
+        s = self.__dict__.get("settings")
+        if s is not None and hasattr(s, name):
+            return getattr(s, name)
+        raise AttributeError(name)
+
+    def set(self, **kw):
+        candidate = _copymod.deepcopy(self.settings)
+        for k, v in kw.items():
+            if not hasattr(candidate, k):
+                self.error.all(f"unknown setting {k!r}")
+            setattr(candidate, k, v)
+        candidate.validate(self.error)  # raises before touching live settings
+        self.settings = candidate
+        return self
+
+    # ------------------------------------------------------------------
+    # internal helpers
+    # ------------------------------------------------------------------
+    def _new_kv(self, name="kv") -> KeyValue:
+        return KeyValue(self.settings, self.error, self.counters, name)
+
+    def _new_kmv(self) -> KeyMultiValue:
+        return KeyMultiValue(self.settings, self.error, self.counters)
+
+    def _require_kv(self, op: str) -> KeyValue:
+        if self.kv is None or not self.kv.complete_done:
+            self.error.all(f"Cannot {op} without completed KeyValue")
+        return self.kv
+
+    def _require_kmv(self, op: str) -> KeyMultiValue:
+        if self.kmv is None:
+            self.error.all(f"Cannot {op} without KeyMultiValue")
+        return self.kmv
+
+    def _start_map(self, addflag: int) -> KeyValue:
+        if self.kmv is not None:
+            self.kmv.free()
+            self.kmv = None
+        if addflag and self.kv is not None:
+            self.kv.append()
+        else:
+            if self.kv is not None:
+                self.kv.free()
+            self.kv = self._new_kv()
+        return self.kv
+
+    def _finish_kv(self, op: str) -> int:
+        if self._open:
+            return self.kv.nkv
+        n = self.kv.complete()
+        n = int(self.backend.allreduce_sum(n))
+        self._op_stats(op, nkv=n)
+        return n
+
+    def _op_stats(self, op: str, **kw):
+        self._last_stats = {"op": op, **kw}
+        if self.settings.verbosity:
+            self.kv_stats(self.settings.verbosity, _op=op)
+
+    # ------------------------------------------------------------------
+    # map family (reference src/mapreduce.cpp:1044-1642)
+    # ------------------------------------------------------------------
+    def map(self, nmap: int, func: Callable, ptr=None, addflag: int = 0) -> int:
+        """Task map: func(itask, kv, ptr) called for nmap tasks
+        (reference map(nmap,func,ptr,addflag) → map_tasks,
+        src/mapreduce.cpp:1044-1225).  mapstyle chunk/stride both reduce to
+        'all tasks' under one controller; style 2 (master-slave) degrades to
+        chunk (SURVEY.md §7)."""
+        t = Timer()
+        kv = self._start_map(addflag)
+        for itask in range(nmap):
+            func(itask, kv, ptr)
+        n = self._finish_kv("map")
+        self._time("map", t)
+        return n
+
+    def map_files(self, files: Union[str, Sequence[str]], func: Callable,
+                  ptr=None, self_flag: int = 0, recurse: int = 0,
+                  readflag: int = 0, addflag: int = 0) -> int:
+        """File map: func(itask, filename, kv, ptr) per file (reference
+        map(nstr,strings,self,recurse,readflag,func,ptr,addflag),
+        src/mapreduce.cpp:1060-1092)."""
+        t = Timer()
+        if isinstance(files, str):
+            files = [files]
+        names = findfiles(files, bool(recurse), bool(readflag))
+        kv = self._start_map(addflag)
+        for itask, fname in enumerate(names):
+            func(itask, fname, kv, ptr)
+        n = self._finish_kv("map_files")
+        self._time("map_files", t)
+        return n
+
+    def map_file_char(self, nmap: int, files, recurse: int, readflag: int,
+                      sepchar: Union[str, bytes], delta: int, func: Callable,
+                      ptr=None, addflag: int = 0) -> int:
+        """Chunk map with single-char separator (reference
+        src/mapreduce.cpp:1232-1301,1312-1469): split files into ~nmap chunks
+        ending on sepchar; func(itask, chunk_bytes, kv, ptr)."""
+        return self._map_chunks(nmap, files, recurse, readflag,
+                                _to_bytes(sepchar), delta, func, ptr, addflag)
+
+    def map_file_str(self, nmap: int, files, recurse: int, readflag: int,
+                     sepstr: Union[str, bytes], delta: int, func: Callable,
+                     ptr=None, addflag: int = 0) -> int:
+        """Chunk map with string separator (reference map_chunks sepstr
+        variant)."""
+        return self._map_chunks(nmap, files, recurse, readflag,
+                                _to_bytes(sepstr), delta, func, ptr, addflag)
+
+    def _map_chunks(self, nmap, files, recurse, readflag, sep, delta,
+                    func, ptr, addflag) -> int:
+        t = Timer()
+        if isinstance(files, str):
+            files = [files]
+        names = findfiles(files, bool(recurse), bool(readflag))
+        if not names:
+            self.error.all("No files found for chunked map")
+        per_file = max(1, nmap // max(1, len(names)))
+        kv = self._start_map(addflag)
+        itask = 0
+        for fname in names:
+            for chunk in file_chunks(fname, per_file, sep, delta):
+                func(itask, chunk, kv, ptr)
+                itask += 1
+        n = self._finish_kv("map_chunks")
+        self._time("map_chunks", t)
+        return n
+
+    def map_mr(self, mr: "MapReduce", func: Callable, ptr=None,
+               addflag: int = 0, batch: bool = False) -> int:
+        """Map over an existing MR's KV pairs (reference map(mr,func,...),
+        src/mapreduce.cpp:1560-1642; self-map via snapshot 1584-1601).
+
+        host path: func(itask, key, value, kv, ptr) per pair;
+        batch path: func(frame, kv, ptr) per KVFrame (vectorised)."""
+        t = Timer()
+        src = mr._require_kv("map over")
+        src_frames = list(src.frames())  # snapshot supports self-map
+        kv = self._start_map(addflag)
+        itask = 0
+        for fr in src_frames:
+            if batch:
+                func(fr, kv, ptr)
+                itask += 1
+            else:
+                for k, v in fr.pairs():
+                    func(itask, k, v, kv, ptr)
+                    itask += 1
+        n = self._finish_kv("map_mr")
+        self._time("map_mr", t)
+        return n
+
+    # ------------------------------------------------------------------
+    # shuffle / distribution ops
+    # ------------------------------------------------------------------
+    def aggregate(self, hash_fn: Optional[Callable] = None) -> int:
+        """THE shuffle: each key to one proc — user hash or
+        hashlittle(key)%nprocs (reference src/mapreduce.cpp:385-563;
+        call stack SURVEY.md §3.2).  Serial backend: no-op."""
+        t = Timer()
+        kv = self._require_kv("aggregate")
+        self.backend.aggregate(self, hash_fn)
+        self._op_stats("aggregate", nkv=kv.nkv)
+        self._time("aggregate", t, comm=True)
+        return int(self.backend.allreduce_sum(kv.nkv))
+
+    def broadcast(self, root: int = 0) -> int:
+        """Replicate root's KV on all procs (reference
+        src/mapreduce.cpp:569-623)."""
+        kv = self._require_kv("broadcast")
+        self.backend.broadcast(self, root)
+        return int(self.backend.allreduce_sum(kv.nkv))
+
+    def gather(self, nprocs: int) -> int:
+        """Funnel KV onto the first nprocs procs (reference
+        src/mapreduce.cpp:893-1036)."""
+        kv = self._require_kv("gather")
+        if nprocs <= 0:
+            self.error.all("Cannot gather to fewer than 1 processor")
+        self.backend.gather(self, nprocs)
+        return int(self.backend.allreduce_sum(kv.nkv))
+
+    def scrunch(self, nprocs: int, key) -> int:
+        """gather + collapse (reference src/mapreduce.cpp:2075-2095)."""
+        self.gather(nprocs)
+        return self.collapse(key)
+
+    # ------------------------------------------------------------------
+    # grouping ops
+    # ------------------------------------------------------------------
+    def convert(self) -> int:
+        """Local KV→KMV grouping (reference src/mapreduce.cpp:861-886 →
+        KeyMultiValue::convert; here sort+segment, SURVEY.md §3.3)."""
+        t = Timer()
+        kv = self._require_kv("convert")
+        frame = kv.one_frame()
+        kmv_frame = group_frame(frame)
+        kv.free()
+        self.kv = None
+        self.kmv = self._new_kmv()
+        self.kmv.push(kmv_frame)
+        n = self.kmv.complete()
+        self._op_stats("convert", nkmv=n)
+        self._time("convert", t)
+        return int(self.backend.allreduce_sum(n))
+
+    def collate(self, hash_fn: Optional[Callable] = None) -> int:
+        """aggregate + convert (reference src/mapreduce.cpp:710-738)."""
+        self.aggregate(hash_fn)
+        return self.convert()
+
+    def clone(self) -> int:
+        """KV→KMV, each pair its own 1-value group (reference
+        src/mapreduce.cpp:631-652)."""
+        kv = self._require_kv("clone")
+        fr = kv.one_frame()
+        n = len(fr)
+        kmv_frame = KMVFrame(fr.key, np.ones(n, np.int64),
+                             np.arange(n + 1, dtype=np.int64), fr.value)
+        kv.free()
+        self.kv = None
+        self.kmv = self._new_kmv()
+        self.kmv.push(kmv_frame)
+        return int(self.backend.allreduce_sum(self.kmv.complete()))
+
+    def collapse(self, key) -> int:
+        """KV→single KMV group per proc: multivalue = [k1,v1,k2,v2,...]
+        (reference src/mapreduce.cpp:681-702).  Keys and values must share a
+        representable common type (all bytes, or all numeric of one shape) —
+        the reference interleaves raw bytes; we interleave typed rows and
+        refuse to silently coerce across types."""
+        kv = self._require_kv("collapse")
+        fr = kv.one_frame().to_host()
+        rows: list = []
+        for k, v in fr.pairs():
+            rows.append(k)
+            rows.append(v)
+        values = _interleave_rows(rows, self.error)
+        n = len(rows)
+        kmv_frame = KMVFrame(_rows_to_column([key]), np.asarray([n]),
+                             np.asarray([0, n]), values)
+        kv.free()
+        self.kv = None
+        self.kmv = self._new_kmv()
+        self.kmv.push(kmv_frame)
+        return int(self.backend.allreduce_sum(self.kmv.complete()))
+
+    # ------------------------------------------------------------------
+    # reduce family
+    # ------------------------------------------------------------------
+    def reduce(self, func: Callable, ptr=None, batch: bool = False) -> int:
+        """Callback per KMV group → new KV (reference
+        src/mapreduce.cpp:1769-1867; SURVEY.md §3.4).
+
+        host path: func(key, values_list, kv, ptr) per group;
+        batch path: func(kmv_frame, kv, ptr) per KMVFrame — the vectorised
+        tier that keeps reduction on device (segment ops)."""
+        t = Timer()
+        kmv = self._require_kmv("reduce")
+        kv = self._new_kv()
+        for fr in kmv.frames():
+            if batch:
+                func(fr, kv, ptr)
+            else:
+                for k, vals in fr.groups():
+                    func(k, vals, kv, ptr)
+        kmv.free()
+        self.kmv = None
+        self.kv = kv
+        return self._finish_kv("reduce")
+
+    def compress(self, func: Callable, ptr=None, batch: bool = False) -> int:
+        """Local convert + reduce, KV→KV — the combiner (reference
+        src/mapreduce.cpp:749-851)."""
+        self.convert()
+        return self.reduce(func, ptr, batch=batch)
+
+    # ------------------------------------------------------------------
+    # scan / print (read-only)
+    # ------------------------------------------------------------------
+    def scan_kv(self, func: Callable, ptr=None, batch: bool = False) -> int:
+        """Read-only iteration over KV pairs (reference
+        src/mapreduce.cpp:1933-1997)."""
+        kv = self._require_kv("scan")
+        for fr in kv.frames():
+            if batch:
+                func(fr, ptr)
+            else:
+                for k, v in fr.pairs():
+                    func(k, v, ptr)
+        return int(self.backend.allreduce_sum(kv.nkv))
+
+    def scan_kmv(self, func: Callable, ptr=None, batch: bool = False) -> int:
+        """Read-only iteration over KMV groups (reference
+        src/mapreduce.cpp:2000-2065)."""
+        kmv = self._require_kmv("scan")
+        for fr in kmv.frames():
+            if batch:
+                func(fr, ptr)
+            else:
+                for k, vals in fr.groups():
+                    func(k, vals, ptr)
+        return int(self.backend.allreduce_sum(kmv.nkmv))
+
+    def print(self, nstride: int = 1, kflag: int = -1, vflag: int = -1,
+              file=None, fflag: int = 0) -> int:
+        """Formatted dump of KV pairs or KMV groups (reference print variants
+        src/mapreduce.cpp:1671-1761; type decoders keyvalue.cpp:773-835).
+        kflag/vflag are accepted for API parity; columns self-describe, so
+        they only force integer/float/string formatting when >=0."""
+        out = sys.stdout if file is None else (open(file, "a") if fflag else open(file, "w"))
+        try:
+            if self.kv is not None:
+                count = 0
+                for fr in self.kv.frames():
+                    for k, v in fr.pairs():
+                        if count % nstride == 0:
+                            out.write(f"{_fmt(k, kflag)} {_fmt(v, vflag)}\n")
+                        count += 1
+                return self.kv.nkv
+            if self.kmv is not None:
+                for fr in self.kmv.frames():
+                    for k, vals in fr.groups():
+                        out.write(f"{_fmt(k, kflag)} " +
+                                  " ".join(_fmt(v, vflag) for v in vals) + "\n")
+                return self.kmv.nkmv
+            self.error.all("Cannot print without KeyValue or KeyMultiValue")
+        finally:
+            if file is not None:
+                out.close()
+
+    # ------------------------------------------------------------------
+    # sorting (reference src/mapreduce.cpp:2102-2352)
+    # ------------------------------------------------------------------
+    def sort_keys(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
+        """Per-proc sort of KV by key.  int flag: |flag| selects the
+        reference's pre-built comparator family (moot for typed columns),
+        sign selects direction (reference flags ±1..6,
+        src/mapreduce.cpp:2102-2126,2692-2802).  Callable: compare(a,b)→-1/0/1
+        (appcompare)."""
+        return self._sort_kv(by="key", flag_or_cmp=flag_or_cmp)
+
+    def sort_values(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
+        """Per-proc sort of KV by value (reference src/mapreduce.cpp:2152)."""
+        return self._sort_kv(by="value", flag_or_cmp=flag_or_cmp)
+
+    def _sort_kv(self, by: str, flag_or_cmp) -> int:
+        t = Timer()
+        kv = self._require_kv(f"sort_{by}s")
+        fr = kv.one_frame()
+        col = fr.key if by == "key" else fr.value
+        if callable(flag_or_cmp):
+            order = argsort_column(col, cmp=flag_or_cmp)
+        else:
+            order = argsort_column(col, descending=flag_or_cmp < 0)
+        fr2 = fr.take(order)
+        kv.free()
+        kv.add_batch(fr2.key, fr2.value)
+        n = kv.complete()
+        self._op_stats(f"sort_{by}s", nkv=n)
+        self._time("sort", t)
+        return int(self.backend.allreduce_sum(n))
+
+    def sort_multivalues(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
+        """Sort values *within* each multivalue (reference
+        src/mapreduce.cpp:2210-2352)."""
+        t = Timer()
+        kmv = self._require_kmv("sort_multivalues")
+        new = self._new_kmv()
+        for fr in kmv.frames():
+            pieces = []
+            for i in range(len(fr)):
+                col = fr.group_values(i)
+                if callable(flag_or_cmp):
+                    order = argsort_column(col, cmp=flag_or_cmp)
+                else:
+                    order = argsort_column(col, descending=flag_or_cmp < 0)
+                pieces.append(col.take(order))
+            values = concat(pieces) if pieces else fr.values
+            new.push(KMVFrame(fr.key, fr.nvalues, fr.offsets, values))
+        kmv.free()
+        self.kmv = new
+        self._time("sort", t)
+        return int(self.backend.allreduce_sum(new.complete()))
+
+    # ------------------------------------------------------------------
+    # whole-object ops
+    # ------------------------------------------------------------------
+    def add(self, mr: "MapReduce") -> int:
+        """Append mr's KV pairs to my KV (reference
+        src/mapreduce.cpp:348-374)."""
+        src = mr._require_kv("add from")
+        if self.kv is None:
+            self.kv = self._new_kv()
+        else:
+            self.kv.append()
+        self.kv.add_kv(src)
+        return self._finish_kv("add")
+
+    def copy(self) -> "MapReduce":
+        """Deep copy: new MR with copied settings and data (reference
+        src/mapreduce.cpp:269-342)."""
+        mr = MapReduce()
+        mr.backend = self.backend
+        mr.settings = _copymod.deepcopy(self.settings)
+        if self.kv is not None:
+            mr.kv = mr._new_kv()
+            mr.kv.add_kv(self.kv)
+            mr.kv.complete()
+        if self.kmv is not None:
+            mr.kmv = mr._new_kmv()
+            for fr in self.kmv.frames():
+                mr.kmv.push(fr)
+            mr.kmv.complete()
+        return mr
+
+    def open(self, addflag: int = 0):
+        """Begin cross-MR adds: my KV accepts kv.add() from other MRs'
+        callbacks until close() (reference src/mapreduce.cpp:1648-1664)."""
+        self._start_map(addflag)
+        self._open = True
+        return self.kv
+
+    def close(self) -> int:
+        """End cross-MR adds (reference src/mapreduce.cpp:658-672)."""
+        if not self._open:
+            self.error.all("Cannot close without open")
+        self._open = False
+        return self._finish_kv("close")
+
+    # ------------------------------------------------------------------
+    # stats (reference src/mapreduce.cpp:2937-3066)
+    # ------------------------------------------------------------------
+    def kv_stats(self, level: int = 0, _op: str = "") -> tuple:
+        kv = self.kv
+        if kv is None:
+            return (0, 0)
+        n = int(self.backend.allreduce_sum(kv.nkv))
+        nb = int(self.backend.allreduce_sum(kv.nbytes()))
+        if level:
+            print(f"{n} pairs, {nb / (1 << 20):.3g} Mb of KV data "
+                  f"{('after ' + _op) if _op else ''}".rstrip())
+        return (n, nb)
+
+    def kmv_stats(self, level: int = 0) -> tuple:
+        kmv = self.kmv
+        if kmv is None:
+            return (0, 0, 0)
+        g = int(self.backend.allreduce_sum(kmv.nkmv))
+        n = int(self.backend.allreduce_sum(kmv.nvalues))
+        nb = int(self.backend.allreduce_sum(kmv.nbytes()))
+        if level:
+            print(f"{g} pairs, {n} values, {nb / (1 << 20):.3g} Mb of KMV data")
+        return (g, n, nb)
+
+    def cummulative_stats(self, level: int = 1, reset: int = 0):
+        c = self.counters
+        if level:
+            print(f"Cummulative hi-water mem = {c.msizemax / (1 << 20):.3g} Mb")
+            print(f"Cummulative spill I/O = {c.rsize / (1 << 20):.3g} Mb read, "
+                  f"{c.wsize / (1 << 20):.3g} Mb written")
+            print(f"Cummulative comm = {c.cssize / (1 << 20):.3g} Mb sent, "
+                  f"{c.crsize / (1 << 20):.3g} Mb received, "
+                  f"{c.commtime:.3g} secs")
+        if reset:
+            c.__init__()
+        return c
+
+    def _time(self, op: str, t: Timer, comm: bool = False):
+        dt = t.elapsed()
+        if comm:
+            self.counters.commtime += dt
+        if self.settings.timer:
+            print(f"{op} time (secs) = {dt:.6g}")
+
+
+# ---------------------------------------------------------------------------
+
+def _to_bytes(s) -> bytes:
+    return s.encode() if isinstance(s, str) else bytes(s)
+
+
+def _rows_to_column(rows: list) -> Column:
+    first = rows[0] if rows else 0
+    if isinstance(first, (bytes, str)):
+        return BytesColumn([r.encode() if isinstance(r, str) else r
+                            for r in rows])
+    return DenseColumn(np.asarray(rows))
+
+
+def _interleave_rows(rows: list, error: Error) -> Column:
+    """Build the collapse() multivalue column, refusing mixed types."""
+    if not rows:
+        return DenseColumn(np.zeros(0, np.int64))
+    if all(isinstance(r, (bytes, str)) for r in rows):
+        return BytesColumn([r.encode() if isinstance(r, str) else r
+                            for r in rows])
+    if any(isinstance(r, (bytes, str)) for r in rows):
+        error.all("collapse requires keys and values of a common type "
+                  "(all bytes or all numeric)")
+    arr = np.asarray(rows)
+    if arr.dtype == object:
+        error.all("collapse requires keys and values of a common shape")
+    return DenseColumn(arr)
+
+
+def _fmt(x, flag: int) -> str:
+    if isinstance(x, bytes):
+        try:
+            return x.decode()
+        except UnicodeDecodeError:
+            return repr(x)
+    if isinstance(x, tuple):
+        return " ".join(_fmt(e, flag) for e in x)
+    if isinstance(x, float) or flag in (3, 4):
+        return f"{x:g}"
+    return str(x)

@@ -1,0 +1,106 @@
+"""Runtime state: settings, error policy, cumulative statistics.
+
+Mirrors the reference's user-visible settings fields
+(``src/mapreduce.h:28-41``, semantics ``doc/settings.txt:12-24``) and the
+static cross-instance counters (``src/mapreduce.h:46-57``,
+``src/mapreduce.cpp:40-50``) reported by ``cummulative_stats``
+(``src/mapreduce.cpp:3007-3066``).
+
+TPU reinterpretations (documented, not silently dropped):
+
+* ``memsize`` (MB) — still the page/frame budget: a dataset frame holds at
+  most ``memsize`` MB and datasets exceeding ``maxpage`` frames in HBM spill
+  to host DRAM (and to ``fpath`` on disk when ``outofcore=1``).
+* ``keyalign``/``valuealign`` — byte alignment is meaningless for columnar
+  arrays; accepted and ignored (validated like the reference,
+  ``src/mapreduce.cpp:251-261``).
+* ``all2all`` — selects the shuffle transport: 1 = single fused all_to_all
+  collective, 0 = ppermute ring (the reference's MPI_Alltoallv vs.
+  Irecv/Send ring, ``src/irregular.cpp:254-363``).
+* ``mapstyle`` — 0 chunk / 1 stride task assignment kept; 2 (master-slave
+  MPI work queue) is accepted but falls back to chunk with a warning
+  (SURVEY.md §7: dynamic scheduling dropped by design).
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class MRError(RuntimeError):
+    """Raised for fatal conditions (the reference's error->all/one,
+    src/error.cpp:33-67 — both abort; in-process we raise instead)."""
+
+
+class Error:
+    def all(self, msg: str):  # collective fatal
+        raise MRError(msg)
+
+    def one(self, msg: str):  # single-rank fatal
+        raise MRError(msg)
+
+    def warning(self, msg: str):
+        warnings.warn(msg, stacklevel=3)
+
+
+@dataclass
+class Settings:
+    mapstyle: int = 0       # 0 chunk, 1 stride, 2 master-slave (degraded)
+    all2all: int = 1        # shuffle transport (fused collective vs ring)
+    verbosity: int = 0      # 0 silent, 1 totals, 2 + per-shard histograms
+    timer: int = 0          # 0 off, 1 totals, 2 + per-shard histograms
+    memsize: int = 64       # MB per frame (reference default 64, mapreduce.cpp:209)
+    minpage: int = 0
+    maxpage: int = 0        # max frames resident in HBM; 0 = unlimited
+    freepage: int = 1
+    outofcore: int = 0      # 1 = allow disk spill under fpath; -1 = never
+    zeropage: int = 0
+    keyalign: int = 8       # accepted, ignored (columnar)
+    valuealign: int = 8
+    fpath: str = "."        # spill-file directory (reference MRMPI_FPATH)
+
+    def validate(self, error: Error):
+        if self.memsize <= 0:
+            error.all("Invalid memsize setting")
+        if self.mapstyle not in (0, 1, 2):
+            error.all("Invalid mapstyle setting")
+        for a in (self.keyalign, self.valuealign):
+            if a <= 0 or (a & (a - 1)):
+                error.all("Alignment setting must be power of 2")
+
+
+@dataclass
+class Counters:
+    """Cumulative cross-instance stats (reference mapreduce.h:46-57)."""
+    msize: int = 0          # current bytes resident (HBM frames)
+    msizemax: int = 0       # hi-water
+    rsize: int = 0          # bytes read from spill files
+    wsize: int = 0          # bytes written to spill files
+    cssize: int = 0         # bytes sent in shuffles
+    crsize: int = 0         # bytes received in shuffles
+    commtime: float = 0.0   # seconds in collectives
+
+    def mem(self, delta: int):
+        self.msize += delta
+        if self.msize > self.msizemax:
+            self.msizemax = self.msize
+
+
+class Timer:
+    __slots__ = ("t0",)
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.t0
+
+
+_GLOBAL_COUNTERS = Counters()
+
+
+def global_counters() -> Counters:
+    return _GLOBAL_COUNTERS

@@ -1,0 +1,177 @@
+"""lookup3 hashing (Bob Jenkins, public domain algorithm) — JAX/TPU port.
+
+The reference uses ``hashlittle()`` (reference ``src/hash.cpp:104``) for two
+jobs: key→process partitioning in ``MapReduce::aggregate``
+(``src/mapreduce.cpp:469-472``) and key→bucket in ``KeyMultiValue::convert``
+(``src/keymultivalue.cpp:1430``).  We re-implement the same algorithm twice:
+
+* :func:`hashlittle` — exact scalar port over arbitrary ``bytes`` (host path,
+  string keys).  Bit-identical to the C version for any input.
+* :func:`hash_words32` — vectorised JAX version over fixed-width keys viewed
+  as little-endian ``uint32`` words.  For inputs whose length is a multiple of
+  4 bytes this is bit-identical to ``hashlittle`` on the equivalent byte
+  string (the C code's aligned ``k[0..2]`` path), so device-side partitioning
+  of u64 graph keys agrees exactly with host-side hashing of the same bytes.
+
+Unlike the reference we also need a 64-bit variant (:func:`hash_bytes64`) for
+string interning: variable-length byte keys are mapped to u64 ids so they can
+live in TPU registers; the id→bytes dictionary stays on the host
+(SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # the module must import host-side even if jax is unavailable
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jnp = None
+
+_M32 = 0xFFFFFFFF
+
+
+def _rot(x: int, k: int) -> int:
+    return ((x << k) | (x >> (32 - k))) & _M32
+
+
+def _mix(a: int, b: int, c: int):
+    # lookup3 mix() — reference src/hash.cpp:50-57 region
+    a = (a - c) & _M32; a ^= _rot(c, 4); c = (c + b) & _M32
+    b = (b - a) & _M32; b ^= _rot(a, 6); a = (a + c) & _M32
+    c = (c - b) & _M32; c ^= _rot(b, 8); b = (b + a) & _M32
+    a = (a - c) & _M32; a ^= _rot(c, 16); c = (c + b) & _M32
+    b = (b - a) & _M32; b ^= _rot(a, 19); a = (a + c) & _M32
+    c = (c - b) & _M32; c ^= _rot(b, 4); b = (b + a) & _M32
+    return a, b, c
+
+
+def _final(a: int, b: int, c: int):
+    # lookup3 final() — reference src/hash.cpp:69-77 region
+    c ^= b; c = (c - _rot(b, 14)) & _M32
+    a ^= c; a = (a - _rot(c, 11)) & _M32
+    b ^= a; b = (b - _rot(a, 25)) & _M32
+    c ^= b; c = (c - _rot(b, 16)) & _M32
+    a ^= c; a = (a - _rot(c, 4)) & _M32
+    b ^= a; b = (b - _rot(a, 14)) & _M32
+    c ^= b; c = (c - _rot(b, 24)) & _M32
+    return a, b, c
+
+
+def hashlittle(data: bytes, initval: int = 0) -> int:
+    """Exact port of hashlittle(key, length, initval) → uint32.
+
+    Follows the byte-at-a-time (unaligned) formulation, which produces the
+    same result as the aligned word reads in the C code on little-endian
+    machines (reference src/hash.cpp:104-228).
+    """
+    length = len(data)
+    a = b = c = (0xDEADBEEF + length + initval) & _M32
+    i = 0
+    while length > 12:
+        a = (a + int.from_bytes(data[i:i + 4], "little")) & _M32
+        b = (b + int.from_bytes(data[i + 4:i + 8], "little")) & _M32
+        c = (c + int.from_bytes(data[i + 8:i + 12], "little")) & _M32
+        a, b, c = _mix(a, b, c)
+        i += 12
+        length -= 12
+    tail = data[i:]
+    if length == 0:
+        return c
+    pad = tail + b"\x00" * (12 - len(tail))
+    a = (a + int.from_bytes(pad[0:4], "little")) & _M32
+    b = (b + int.from_bytes(pad[4:8], "little")) & _M32
+    c = (c + int.from_bytes(pad[8:12], "little")) & _M32
+    a, b, c = _final(a, b, c)
+    return c
+
+
+def hash_bytes64(data: bytes) -> int:
+    """64-bit intern id for a byte string: two seeded hashlittle passes.
+
+    Equivalent in spirit to lookup3's hashlittle2 (primary+secondary hash).
+    Used for string→u64 interning on the device path; collision probability
+    for n distinct strings is ~n^2/2^64.
+    """
+    hi = hashlittle(data, 0)
+    lo = hashlittle(data, 0xDEADBEEF)
+    return (hi << 32) | lo
+
+
+# ---------------------------------------------------------------------------
+# Vectorised JAX version for fixed-width keys
+# ---------------------------------------------------------------------------
+
+def _jrot(x, k):
+    return (x << np.uint32(k)) | (x >> np.uint32(32 - k))
+
+
+def _jmix(a, b, c):
+    a = a - c; a = a ^ _jrot(c, 4); c = c + b
+    b = b - a; b = b ^ _jrot(a, 6); a = a + c
+    c = c - b; c = c ^ _jrot(b, 8); b = b + a
+    a = a - c; a = a ^ _jrot(c, 16); c = c + b
+    b = b - a; b = b ^ _jrot(a, 19); a = a + c
+    c = c - b; c = c ^ _jrot(b, 4); b = b + a
+    return a, b, c
+
+
+def _jfinal(a, b, c):
+    c = c ^ b; c = c - _jrot(b, 14)
+    a = a ^ c; a = a - _jrot(c, 11)
+    b = b ^ a; b = b - _jrot(a, 25)
+    c = c ^ b; c = c - _jrot(b, 16)
+    a = a ^ c; a = a - _jrot(c, 4)
+    b = b ^ a; b = b - _jrot(a, 14)
+    c = c ^ b; c = c - _jrot(b, 24)
+    return a, b, c
+
+
+def hash_words32(words, initval: int = 0):
+    """Vectorised hashlittle over uint32-word keys.
+
+    ``words``: array of shape [..., W] (uint32), each row one key of 4*W
+    bytes.  Returns uint32 hashes of shape [...].  Bit-identical to
+    :func:`hashlittle` on the corresponding little-endian byte strings.
+
+    W is static, so the word loop unrolls at trace time — XLA sees a fixed
+    chain of vector int ops, which fuses into surrounding kernels.
+    """
+    xp = jnp if (jnp is not None and not isinstance(words, np.ndarray)) else np
+    words = words.astype(np.uint32)
+    w = words.shape[-1]
+    length = np.uint32(4 * w)
+    init = np.uint32((0xDEADBEEF + int(length) + initval) & _M32)
+    a = xp.full(words.shape[:-1], init, dtype=np.uint32)
+    b = a
+    c = a
+    i = 0
+    while w > 3:
+        a = a + words[..., i]
+        b = b + words[..., i + 1]
+        c = c + words[..., i + 2]
+        a, b, c = _jmix(a, b, c)
+        i += 3
+        w -= 3
+    if w == 0:
+        return c
+    if w >= 1:
+        a = a + words[..., i]
+    if w >= 2:
+        b = b + words[..., i + 1]
+    if w >= 3:
+        c = c + words[..., i + 2]
+    a, b, c = _jfinal(a, b, c)
+    return c
+
+
+def hash_u64(keys, initval: int = 0):
+    """Hash an array of uint64 keys → uint32, matching hashlittle on their
+    8-byte little-endian encodings (the aggregate() partition hash applied to
+    the reference's VERTEX=uint64 graph keys, oink/typedefs.h:22)."""
+    xp = jnp if (jnp is not None and not isinstance(keys, np.ndarray)) else np
+    keys = keys.astype(np.uint64)
+    lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    words = xp.stack([lo, hi], axis=-1)
+    return hash_words32(words, initval)

@@ -1,0 +1,42 @@
+"""Distributed mesh backend — sharded datasets + ICI collectives.
+
+Replaces the reference's MPI layer (``src/irregular.*`` + direct MPI calls in
+``mapreduce.cpp``): a ``jax.sharding.Mesh`` over axis ``"p"`` plays the role
+of MPI_COMM_WORLD, and the shuffle/gather/broadcast ops run as XLA
+collectives (SURVEY.md §5 "Distributed communication backend").
+
+Implemented in ``shuffle.py``/``collectives.py``; this module holds the
+backend object the MapReduce class dispatches to.
+"""
+
+from __future__ import annotations
+
+from ..core.runtime import MRError
+
+
+class MeshBackend:
+    """Sharded execution over a jax.sharding.Mesh (axis name "p")."""
+
+    def __init__(self, mesh):
+        try:
+            from .shuffle import mesh_axis_size
+        except ImportError as e:  # pragma: no cover
+            raise MRError(f"mesh backend unavailable: {e}") from e
+        self.mesh = mesh
+        self.nprocs = mesh_axis_size(mesh)
+        self.me = 0
+
+    def aggregate(self, mr, hash_fn):
+        from .shuffle import aggregate_kv
+        aggregate_kv(self, mr, hash_fn)
+
+    def gather(self, mr, nprocs: int):
+        from .collectives import gather_kv
+        gather_kv(self, mr, nprocs)
+
+    def broadcast(self, mr, root: int):
+        from .collectives import broadcast_kv
+        broadcast_kv(self, mr, root)
+
+    def allreduce_sum(self, x):
+        return x  # dataset counts are already global (controller-side)

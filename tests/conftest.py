@@ -1,0 +1,28 @@
+"""Test configuration: fake an 8-device cluster on CPU.
+
+The reference tests "multi-node" code serially by linking mpistubs/ (a fake
+1-proc MPI).  Our equivalent trick runs JAX on CPU with 8 virtual devices
+(SURVEY.md §4), so mesh/sharding/collective code paths execute for real
+without TPU hardware.  Must run before jax initialises its backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

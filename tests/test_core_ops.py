@@ -1,0 +1,241 @@
+"""Oracle tests for the MapReduce op algebra vs plain Python dicts
+(SURVEY.md §4: the test layer the reference never had)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu import MapReduce
+
+
+def emit_ints(itask, kv, ptr):
+    # 10 tasks x 20 keys with collisions
+    for i in range(20):
+        kv.add((itask * 7 + i * 3) % 13, itask * 100 + i)
+
+
+def build_int_mr():
+    mr = MapReduce()
+    mr.map(10, emit_ints)
+    return mr
+
+
+def oracle_groups():
+    groups = collections.defaultdict(list)
+    for itask in range(10):
+        for i in range(20):
+            groups[(itask * 7 + i * 3) % 13].append(itask * 100 + i)
+    return groups
+
+
+def test_map_counts():
+    mr = build_int_mr()
+    assert mr.kv.nkv == 200
+    assert mr.kv_stats() == (200, mr.kv.nbytes())
+
+
+def test_map_batch_add():
+    mr = MapReduce()
+
+    def emit(itask, kv, ptr):
+        kv.add_batch(np.arange(5, dtype=np.uint64) + itask,
+                     np.full(5, itask, dtype=np.int64))
+
+    n = mr.map(4, emit)
+    assert n == 20
+
+
+def test_convert_matches_oracle():
+    mr = build_int_mr()
+    n = mr.convert()
+    oracle = oracle_groups()
+    assert n == len(oracle)
+    got = {k: sorted(v) for k, v in mr_groups(mr).items()}
+    assert got == {k: sorted(v) for k, v in oracle.items()}
+
+
+def mr_groups(mr):
+    out = {}
+
+    def collect(key, values, ptr):
+        out[key] = list(values)
+
+    mr.scan_kmv(collect)
+    return out
+
+
+def test_reduce_sum_matches_oracle():
+    mr = build_int_mr()
+    mr.convert()
+
+    def sum_values(key, values, kv, ptr):
+        kv.add(key, sum(values))
+
+    n = mr.reduce(sum_values)
+    oracle = {k: sum(v) for k, v in oracle_groups().items()}
+    assert n == len(oracle)
+    got = dict(kv_pairs(mr))
+    assert got == oracle
+
+
+def kv_pairs(mr):
+    pairs = []
+
+    def collect(k, v, ptr):
+        pairs.append((k, v))
+
+    mr.scan_kv(collect)
+    return pairs
+
+
+def test_compress_equals_convert_reduce():
+    def count(key, values, kv, ptr):
+        kv.add(key, len(values))
+
+    mr1 = build_int_mr()
+    mr1.compress(count)
+    mr2 = build_int_mr()
+    mr2.convert()
+    mr2.reduce(count)
+    assert dict(kv_pairs(mr1)) == dict(kv_pairs(mr2))
+
+
+def test_reduce_batch_segment_sum():
+    import jax.numpy as jnp
+    from gpu_mapreduce_tpu.ops.segment import kmv_segment_ids, segment_reduce
+
+    mr = build_int_mr()
+    mr.convert()
+
+    def batch_sum(frame, kv, ptr):
+        seg = kmv_segment_ids(frame)
+        vals = jnp.asarray(np.asarray(frame.values.data))
+        sums = segment_reduce(vals, jnp.asarray(seg), len(frame), "sum")
+        kv.add_batch(frame.key, sums)
+
+    mr.reduce(batch_sum, batch=True)
+    oracle = {k: sum(v) for k, v in oracle_groups().items()}
+    assert dict(kv_pairs(mr)) == oracle
+
+
+def test_clone_and_collapse():
+    mr = MapReduce()
+    mr.map(1, lambda t, kv, p: [kv.add(i, i * i) for i in range(5)])
+    mr.clone()
+    groups = mr_groups(mr)
+    assert groups == {i: [i * i] for i in range(5)}
+
+    mr2 = MapReduce()
+    mr2.map(1, lambda t, kv, p: [kv.add(i, i * i) for i in range(3)])
+    mr2.collapse(99)
+    groups = mr_groups(mr2)
+    assert list(groups) == [99]
+    assert sorted(groups[99]) == sorted([0, 0, 1, 1, 2, 4])
+
+
+def test_sort_keys_and_values():
+    mr = MapReduce()
+    vals = [5, 3, 9, 1, 7]
+    mr.map(1, lambda t, kv, p: [kv.add(v, -v) for v in vals])
+    mr.sort_keys(1)
+    assert [k for k, _ in kv_pairs(mr)] == sorted(vals)
+    mr.sort_keys(-1)
+    assert [k for k, _ in kv_pairs(mr)] == sorted(vals, reverse=True)
+    mr.sort_values(1)
+    assert [v for _, v in kv_pairs(mr)] == sorted(-v for v in vals)
+
+
+def test_sort_keys_custom_compare():
+    mr = MapReduce()
+    mr.map(1, lambda t, kv, p: [kv.add(v, 0) for v in (5, 3, 9, 1, 7)])
+    # descending via user compare callback (appcompare parity)
+    mr.sort_keys(lambda a, b: (b > a) - (b < a))
+    assert [k for k, _ in kv_pairs(mr)] == [9, 7, 5, 3, 1]
+
+
+def test_sort_multivalues():
+    mr = MapReduce()
+    mr.map(1, lambda t, kv, p: [kv.add(i % 2, 10 - i) for i in range(6)])
+    mr.convert()
+    mr.sort_multivalues(1)
+    groups = mr_groups(mr)
+    assert groups[0] == sorted(groups[0])
+    assert groups[1] == sorted(groups[1])
+
+
+def test_bytes_keys_roundtrip():
+    words = [b"apple", b"pear", b"apple", b"fig", b"pear", b"apple"]
+    mr = MapReduce()
+    mr.map(1, lambda t, kv, p: [kv.add(w, 1) for w in words])
+
+    def count(key, values, kv, ptr):
+        kv.add(key, len(values))
+
+    mr.compress(count)
+    assert dict(kv_pairs(mr)) == {b"apple": 3, b"pear": 2, b"fig": 1}
+
+
+def test_add_and_copy_and_open_close():
+    mr1 = MapReduce()
+    mr1.map(1, lambda t, kv, p: [kv.add(i, 1) for i in range(3)])
+    mr2 = MapReduce()
+    mr2.map(1, lambda t, kv, p: [kv.add(i, 2) for i in range(3, 5)])
+    n = mr1.add(mr2)
+    assert n == 5
+    mr3 = mr1.copy()
+    assert mr3.kv.nkv == 5 and mr3 is not mr1
+
+    # open/close cross-MR adds (reference open()/close())
+    acc = MapReduce()
+    kvh = acc.open()
+    src = MapReduce()
+    src.map(1, lambda t, kv, p: [kv.add(9, 9)])
+    src.scan_kv(lambda k, v, p: kvh.add(k, v))
+    assert acc.close() == 1
+
+
+def test_map_mr_and_self_map():
+    mr = MapReduce()
+    mr.map(1, lambda t, kv, p: [kv.add(i, i) for i in range(4)])
+
+    def double(itask, key, value, kv, ptr):
+        kv.add(key, value * 2)
+
+    mr.map_mr(mr, double)  # self-map via snapshot
+    assert dict(kv_pairs(mr)) == {i: 2 * i for i in range(4)}
+
+
+def test_serial_shuffle_noops():
+    mr = build_int_mr()
+    assert mr.aggregate() == 200
+    assert mr.gather(1) == 200
+    assert mr.broadcast(0) == 200
+    n = mr.scrunch(1, 42)
+    assert list(mr_groups(mr)) == [42]
+
+
+def test_print_and_settings(tmp_path, capsys):
+    mr = MapReduce(verbosity=0, timer=0)
+    mr.set(memsize=16, fpath=str(tmp_path))
+    assert mr.memsize == 16
+    mr.map(1, lambda t, kv, p: [kv.add(1, 2)])
+    path = tmp_path / "out.txt"
+    mr.print(file=str(path))
+    assert path.read_text() == "1 2\n"
+    with pytest.raises(Exception):
+        mr.set(nosuch=1)
+
+
+def test_tuple_struct_keys():
+    # EDGE={vi,vj} struct keys (oink/typedefs.h) as [n,2] dense columns
+    edges = [(1, 2), (2, 3), (1, 2), (3, 1)]
+    mr = MapReduce()
+    mr.map(1, lambda t, kv, p: [kv.add(e, 1) for e in edges])
+
+    def count(key, values, kv, ptr):
+        kv.add(key, len(values))
+
+    mr.compress(count)
+    got = dict(kv_pairs(mr))
+    assert got == {(1, 2): 2, (2, 3): 1, (3, 1): 1}
