@@ -86,10 +86,7 @@ def wordfreq_interned(files: Sequence[str], ntop: int = 10, comm=None
 
     nwords = mr.map_files(list(files), fileread_ids)
     mr.collate()
-
-    def count(frame, kv, ptr):
-        kv.add_batch(frame.key, np.asarray(frame.nvalues))
-
+    from ..ops.reduces import count
     nunique = mr.reduce(count, batch=True)
     mr.gather(1)
     mr.sort_values(-1)

@@ -113,6 +113,12 @@ class KeyValue:
         for fr in other.frames():
             self._batches.append(fr)
 
+    def add_frame(self, frame):
+        """Append a pre-built frame — a KVFrame, or a parallel.ShardedKV
+        coming out of a vectorised sharded reduce."""
+        self._flush_scalars()
+        self._batches.append(frame)
+
     def _flush_scalars(self):
         if not self._buf_k:
             return
@@ -127,11 +133,16 @@ class KeyValue:
         """Finalise: consolidate buffers into budget-sized frames
         (reference KeyValue::complete, src/keyvalue.cpp:216-255)."""
         self._flush_scalars()
-        if self._batches:
-            merged = _merge_frames(self._batches)
-            self._batches = []
+        plain = [b for b in self._batches if isinstance(b, KVFrame)]
+        opaque = [b for b in self._batches if not isinstance(b, KVFrame)]
+        self._batches = []
+        if plain:
+            merged = _merge_frames(plain)
             for fr in _split_to_budget(merged, self.settings):
                 self._push_frame(fr)
+        for f in opaque:  # sharded frames bypass the page splitter
+            self._frames.append(f)
+            self.counters.mem(f.nbytes())
         self.nkv = sum(self._frame_n(f) for f in self._frames)
         self.complete_done = True
         return self.nkv
@@ -142,7 +153,7 @@ class KeyValue:
         self.complete_done = False
 
     def _frame_n(self, f) -> int:
-        return f.n if isinstance(f, _Spilled) else len(f)
+        return f.n if isinstance(f, _Spilled) else len(f)  # len covers ShardedKV too
 
     def _push_frame(self, fr: KVFrame):
         budget = self.settings.maxpage * self.settings.memsize * (1 << 20)
@@ -181,12 +192,17 @@ class KeyValue:
         for f in self._frames:
             yield f.load(self.counters) if isinstance(f, _Spilled) else f
 
-    def one_frame(self) -> KVFrame:
-        """Whole dataset as a single frame (in-core fast path)."""
+    def one_frame(self):
+        """Whole dataset as a single frame (in-core fast path).  Returns the
+        ShardedKV directly when that's the sole frame; a mixed plain+sharded
+        dataset compacts to host first."""
         frames = list(self.frames())
         if not frames:
             from .frame import empty_kv
             return empty_kv()
+        if len(frames) == 1:
+            return frames[0]
+        frames = [f if isinstance(f, KVFrame) else f.to_host() for f in frames]
         return _merge_frames(frames)
 
     def nbytes(self) -> int:

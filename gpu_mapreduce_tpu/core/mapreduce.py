@@ -282,7 +282,11 @@ class MapReduce:
         t = Timer()
         kv = self._require_kv("convert")
         frame = kv.one_frame()
-        kmv_frame = group_frame(frame)
+        if isinstance(frame, KVFrame):
+            kmv_frame = group_frame(frame)
+        else:  # ShardedKV → per-shard sort+segment under shard_map
+            from ..parallel.group import convert_sharded
+            kmv_frame = convert_sharded(frame, self.counters)
         kv.free()
         self.kv = None
         self.kmv = self._new_kmv()
@@ -302,6 +306,8 @@ class MapReduce:
         src/mapreduce.cpp:631-652)."""
         kv = self._require_kv("clone")
         fr = kv.one_frame()
+        if not isinstance(fr, KVFrame):
+            fr = fr.to_host()
         n = len(fr)
         kmv_frame = KMVFrame(fr.key, np.ones(n, np.int64),
                              np.arange(n + 1, dtype=np.int64), fr.value)
@@ -436,6 +442,17 @@ class MapReduce:
         t = Timer()
         kv = self._require_kv(f"sort_{by}s")
         fr = kv.one_frame()
+        if not isinstance(fr, KVFrame):
+            if not callable(flag_or_cmp):  # per-shard device sort
+                from ..parallel.group import sort_sharded
+                out = sort_sharded(fr, by, descending=flag_or_cmp < 0)
+                kv.free()
+                kv.add_frame(out)
+                n = kv.complete()
+                self._op_stats(f"sort_{by}s", nkv=n)
+                self._time("sort", t)
+                return int(self.backend.allreduce_sum(n))
+            fr = fr.to_host()  # comparator callbacks serialize to host
         col = fr.key if by == "key" else fr.value
         if callable(flag_or_cmp):
             order = argsort_column(col, cmp=flag_or_cmp)
@@ -456,6 +473,14 @@ class MapReduce:
         kmv = self._require_kmv("sort_multivalues")
         new = self._new_kmv()
         for fr in kmv.frames():
+            if not isinstance(fr, KMVFrame):  # ShardedKMV
+                if callable(flag_or_cmp):
+                    fr = fr.to_host()  # comparator callbacks serialize
+                else:
+                    from ..parallel.group import sort_multivalues_sharded
+                    new.push(sort_multivalues_sharded(
+                        fr, descending=flag_or_cmp < 0))
+                    continue
             pieces = []
             for i in range(len(fr)):
                 col = fr.group_values(i)

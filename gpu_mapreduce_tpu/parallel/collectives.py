@@ -1,0 +1,75 @@
+"""gather / broadcast over the mesh.
+
+* gather(n): funnel every shard's rows onto the first n shards — the
+  reference's rank-matched Send/Recv funnel (``src/mapreduce.cpp:893-1036``)
+  becomes one exchange with a constant destination per shard.
+* broadcast(root): every shard ends up with a copy of root's rows — the
+  reference's per-page MPI_Bcast (``src/mapreduce.cpp:569-623``) becomes an
+  ``all_gather`` + select.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.frame import KVFrame
+from .mesh import AXIS, mesh_axis_size, row_sharding
+from .sharded import ShardedKV, round_cap, shard_frame
+from .shuffle import exchange, _replace_kv_frames
+
+
+def _ensure_sharded(backend, mr):
+    frame = mr.kv.one_frame()
+    if isinstance(frame, KVFrame):
+        if not frame.is_dense():
+            return None
+        return shard_frame(frame, backend.mesh)
+    return frame
+
+
+def gather_kv(backend, mr, nprocs: int):
+    skv = _ensure_sharded(backend, mr)
+    if skv is None:
+        return  # host-resident data is already "gathered"
+    n = min(nprocs, backend.nprocs)
+
+    def dest_of(keys):
+        me = lax.axis_index(AXIS)
+        d = (me % n).astype(jnp.int32)
+        return jnp.full(keys.shape[0], d, jnp.int32)
+
+    out = exchange(skv, dest_of, transport=mr.settings.all2all,
+                   counters=mr.counters)
+    _replace_kv_frames(mr.kv, out)
+
+
+def broadcast_kv(backend, mr, root: int):
+    skv = _ensure_sharded(backend, mr)
+    if skv is None:
+        return
+    mesh = skv.mesh
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(key, value):
+        def body(k, v):
+            allk = lax.all_gather(k, AXIS)   # [P, cap, ...]
+            allv = lax.all_gather(v, AXIS)
+            return allk[root], allv[root]
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec),
+                             out_specs=(spec, spec))(key, value)
+
+    k, v = run(skv.key, skv.value)
+    counts = np.full(backend.nprocs, skv.counts[root], np.int32)
+    rowbytes = (skv.key.dtype.itemsize *
+                (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
+                skv.value.dtype.itemsize *
+                (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
+    moved = int(skv.counts[root]) * (backend.nprocs - 1) * rowbytes
+    mr.counters.cssize += moved
+    mr.counters.crsize += moved
+    _replace_kv_frames(mr.kv, ShardedKV(mesh, k, v, counts))

@@ -1,0 +1,262 @@
+"""Sharded convert / sort / segment-reduce — the local half of collate.
+
+The reference's convert is purely local per rank (SURVEY.md §3.3: "No MPI at
+all — the parallelism came from aggregate").  Same here: each shard sorts its
+own block and finds group boundaries under ``shard_map``; no collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .mesh import AXIS, mesh_axis_size, row_sharding
+from .sharded import ShardedKMV, ShardedKV, round_cap
+
+
+def _sort_key_tuple(key, valid):
+    """lexsort key tuple putting invalid rows last, then by key ascending.
+    numpy/jnp lexsort: LAST key is primary."""
+    cols = [key] if key.ndim == 1 else [key[:, j] for j in range(key.shape[1] - 1, -1, -1)]
+    return tuple(cols) + (~valid,)
+
+
+def _local_sort(key, value, count):
+    cap = key.shape[0]
+    valid = jnp.arange(cap) < count
+    order = jnp.lexsort(_sort_key_tuple(key, valid))
+    return (jnp.take(key, order, axis=0), jnp.take(value, order, axis=0), valid)
+
+
+def _boundary(skey, valid):
+    if skey.ndim == 1:
+        diff = skey[1:] != skey[:-1]
+    else:
+        diff = jnp.any(skey[1:] != skey[:-1], axis=1)
+    first = jnp.ones(1, bool)
+    return valid & jnp.concatenate([first, diff])
+
+
+def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
+    """Per-shard sort + boundary detection → grouped frame."""
+    mesh = skv.mesh
+    nprocs = mesh_axis_size(mesh)
+    spec = P(AXIS)
+
+    @jax.jit
+    def phase1(key, value, count):
+        def body(k, v, c):
+            sk, sv, valid = _local_sort(k, v, c)
+            mask = _boundary(sk, valid)
+            return sk, sv, mask, jnp.sum(mask).astype(jnp.int32)[None]
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=(spec, spec, spec, spec))(key, value, count)
+
+    counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
+    skey, svalue, mask, ucounts = phase1(skv.key, skv.value, counts_dev)
+    gcounts = np.asarray(ucounts).astype(np.int32)
+    gcap = round_cap(int(gcounts.max())) if gcounts.max() else 8
+
+    @jax.jit
+    def phase2(skey, mask):
+        def body(sk, m):
+            cap = sk.shape[0]
+            seg = jnp.cumsum(m.astype(jnp.int32)) - 1
+            in_group = seg >= 0  # rows before the first boundary are invalid
+            tgt = jnp.where(m, seg, gcap)
+            # unique keys: first row of each group
+            ushape = (gcap,) + sk.shape[1:]
+            ukey = jnp.zeros(ushape, sk.dtype).at[tgt].set(sk, mode="drop")
+            # group start offsets (shard-local row index)
+            voff = jnp.full(gcap, cap, jnp.int32).at[tgt].set(
+                jnp.arange(cap, dtype=jnp.int32), mode="drop")
+            # per-group sizes: count rows whose running seg == g
+            sizes = jax.ops.segment_sum(
+                jnp.where(in_group, 1, 0).astype(jnp.int32),
+                jnp.where(in_group, seg, gcap), num_segments=gcap + 1)[:gcap]
+            return ukey, sizes.astype(jnp.int32), voff
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec),
+                             out_specs=(spec, spec, spec))(skey, mask)
+
+    ukey, nvalues, voffsets = phase2(skey, mask)
+    # NOTE: rows past `count` were sorted to the end and are not in any group
+    # (their seg id never advances past the last boundary of valid rows —
+    # but padding rows after the last valid row share its seg id).  Correct
+    # sizes by clamping to the valid row count below.
+    nvalues, voffsets = _clamp_sizes(np.asarray(nvalues), np.asarray(voffsets),
+                                     gcounts, skv.counts, gcap)
+    nvalues = jax.device_put(nvalues, row_sharding(mesh))
+    voffsets = jax.device_put(voffsets, row_sharding(mesh))
+    return ShardedKMV(skv.mesh, ukey, nvalues, voffsets, svalue,
+                      gcounts, skv.counts.copy())
+
+
+def _clamp_sizes(nvalues, voffsets, gcounts, vcounts, gcap):
+    """Fix per-group sizes on the host: the last group of each shard must end
+    at the shard's valid row count, not at cap (padding rows sorted to the
+    end inherit the last group's segment id)."""
+    Pn = len(gcounts)
+    nv = nvalues.reshape(Pn, gcap).copy()
+    vo = voffsets.reshape(Pn, gcap).copy()
+    for i in range(Pn):
+        g = int(gcounts[i])
+        if g:
+            last = g - 1
+            nv[i, last] = int(vcounts[i]) - int(vo[i, last])
+            nv[i, g:] = 0
+    return nv.reshape(-1).astype(np.int32), vo.reshape(-1).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# segment reductions over a ShardedKMV (the registered-kernel reduce tier)
+# ---------------------------------------------------------------------------
+
+def _local_segment_ids(voff, nval, vcap: int):
+    """Per-shard value-row → group-id mapping (jittable, shard-local)."""
+    starts = jnp.zeros(vcap + 1, jnp.int32).at[voff].add(
+        jnp.where(nval > 0, 1, 0).astype(jnp.int32), mode="drop")
+    return jnp.cumsum(starts[:vcap]) - 1
+
+
+def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
+                   values_transform: Callable = None) -> ShardedKV:
+    """Vectorised reduce: one output pair per group, computed with XLA
+    segment ops per shard (count/sum/max/min)."""
+    mesh = kmv.mesh
+    gcap = kmv.gcap
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(ukey, nval, voff, values, vcount):
+        def body(uk, nv, vo, vals, vc):
+            if op == "count":
+                return uk, nv.astype(jnp.int64)
+            vcap = vals.shape[0]
+            seg = _local_segment_ids(vo, nv, vcap)
+            valid = jnp.arange(vcap) < vc
+            x = vals if values_transform is None else values_transform(vals)
+            if op == "sum":
+                x = jnp.where(_bmask(valid, x), x, 0)
+                out = jax.ops.segment_sum(x, jnp.where(valid, seg, gcap),
+                                          num_segments=gcap + 1)[:gcap]
+            elif op == "max":
+                out = jax.ops.segment_max(
+                    jnp.where(_bmask(valid, x), x, _tiny(x.dtype)),
+                    jnp.where(valid, seg, gcap), num_segments=gcap + 1)[:gcap]
+            elif op == "min":
+                out = jax.ops.segment_min(
+                    jnp.where(_bmask(valid, x), x, _huge(x.dtype)),
+                    jnp.where(valid, seg, gcap), num_segments=gcap + 1)[:gcap]
+            else:
+                raise ValueError(op)
+            return uk, out
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(spec, spec, spec, spec, spec),
+                             out_specs=(spec, spec))(ukey, nval, voff, values,
+                                                     vcount)
+
+    vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32), row_sharding(mesh))
+    ukey, out = run(kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values, vcounts_dev)
+    return ShardedKV(kmv.mesh, ukey, out, kmv.gcounts.copy())
+
+
+def _bmask(valid, x):
+    return valid if x.ndim == 1 else valid[:, None]
+
+
+def _tiny(dtype):
+    v = (jnp.finfo(dtype).min if jnp.issubdtype(dtype, jnp.floating)
+         else jnp.iinfo(dtype).min)
+    return jnp.array(v, dtype=dtype)  # typed scalar: u64 max overflows weak int
+
+
+def _huge(dtype):
+    v = (jnp.finfo(dtype).max if jnp.issubdtype(dtype, jnp.floating)
+         else jnp.iinfo(dtype).max)
+    return jnp.array(v, dtype=dtype)
+
+
+def first_sharded(kmv: ShardedKMV) -> ShardedKV:
+    """One output pair per group with the group's FIRST value (dedupe/cull)."""
+    mesh = kmv.mesh
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(ukey, voff, values):
+        def body(uk, vo, vals):
+            idx = jnp.minimum(vo, vals.shape[0] - 1)
+            return uk, jnp.take(vals, idx, axis=0)
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=(spec, spec))(ukey, voff, values)
+
+    uk, v = run(kmv.ukey, kmv.voffsets, kmv.values)
+    return ShardedKV(kmv.mesh, uk, v, kmv.gcounts.copy())
+
+
+def sort_multivalues_sharded(kmv: ShardedKMV,
+                             descending: bool = False) -> ShardedKMV:
+    """Sort values within each group, per shard (reference
+    src/mapreduce.cpp:2210-2352).  Stable lexsort by (validity, group,
+    value) keeps every group in its original [voffset, voffset+nvalue)
+    region, so offsets/sizes are unchanged."""
+    mesh = kmv.mesh
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(voff, nval, values, vcount):
+        def body(vo, nv, vals, vc):
+            vcap = vals.shape[0]
+            seg = _local_segment_ids(vo, nv, vcap)
+            valid = jnp.arange(vcap) < vc
+            v = vals if vals.ndim == 1 else vals[:, 0]
+            keyv = _desc_key(v) if descending else v
+            order = jnp.lexsort((keyv, seg, ~valid))
+            return jnp.take(vals, order, axis=0)
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 4,
+                             out_specs=spec)(voff, nval, values, vcount)
+
+    vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32), row_sharding(mesh))
+    values = run(kmv.voffsets, kmv.nvalues, kmv.values, vcounts_dev)
+    return ShardedKMV(kmv.mesh, kmv.ukey, kmv.nvalues, kmv.voffsets, values,
+                      kmv.gcounts.copy(), kmv.vcounts.copy())
+
+
+def _desc_key(v):
+    if jnp.issubdtype(v.dtype, jnp.unsignedinteger):
+        return ~v  # bitwise complement reverses unsigned order
+    return -v
+
+
+# ---------------------------------------------------------------------------
+# per-shard sort (reference sort_keys/sort_values are rank-local)
+# ---------------------------------------------------------------------------
+
+def sort_sharded(skv: ShardedKV, by: str = "key",
+                 descending: bool = False) -> ShardedKV:
+    mesh = skv.mesh
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(key, value, count):
+        def body(k, v, c):
+            col = k if by == "key" else v
+            cap = col.shape[0]
+            valid = jnp.arange(cap) < c
+            order = jnp.lexsort(_sort_key_tuple(col, valid))
+            if descending:
+                r = jnp.arange(cap)
+                pos = jnp.where(r < c, c - 1 - r, r)
+                inv = jnp.zeros(cap, order.dtype).at[pos].set(r)
+                order = jnp.take(order, inv)
+            return jnp.take(k, order, axis=0), jnp.take(v, order, axis=0)
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=(spec, spec))(key, value, count)
+
+    counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
+    k, v = run(skv.key, skv.value, counts_dev)
+    return ShardedKV(mesh, k, v, skv.counts.copy())

@@ -1,0 +1,38 @@
+"""Mesh construction — the framework's MPI_COMM_WORLD.
+
+One flat axis ``"p"`` of "procs" (chips).  The reference's rank/size
+(``MPI_Comm_rank``/``MPI_Comm_size``) become ``lax.axis_index("p")`` and the
+axis size; multi-slice TPU systems can later map ``p`` to (slice, chip) so
+collectives ride ICI within a slice and DCN across (SURVEY.md §5)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS = "p"
+
+
+def make_mesh(ndev: Optional[int] = None, devices: Optional[Sequence] = None
+              ) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if ndev is not None:
+        devices = devices[:ndev]
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def mesh_axis_size(mesh: Mesh) -> int:
+    return int(mesh.shape[AXIS])
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Rows split over procs (axis 0 of every dataset array)."""
+    return NamedSharding(mesh, PartitionSpec(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

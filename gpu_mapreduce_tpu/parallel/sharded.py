@@ -1,0 +1,200 @@
+"""Sharded dataset frames: per-shard padded row blocks + valid counts.
+
+The reference's distributed state is "each rank owns a list of pages"
+(``src/keyvalue.h:83-92``).  Here each *shard* of the mesh owns a padded
+block of rows inside one global ``jax.Array``:
+
+* data arrays have global shape ``[P*cap, ...]``, sharded over mesh axis
+  ``"p"`` on dim 0, so shard i's local block is rows ``[i*cap, (i+1)*cap)``;
+* a host-side ``counts[P]`` records how many leading rows of each block are
+  valid (the rest is padding — the price of XLA's static shapes, standing in
+  for the reference's variable page fill).
+
+Caps are rounded up to powers of two (min 8) so repeated shuffles re-use
+compiled programs instead of recompiling per exact size.
+
+``ShardedKV`` quacks enough like a ``KVFrame`` (len/nbytes/pairs/to_host)
+to sit inside a ``KeyValue`` dataset as a frame; same for ``ShardedKMV``
+vs ``KMVFrame``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from ..core.column import DenseColumn
+from ..core.frame import KMVFrame, KVFrame
+from .mesh import mesh_axis_size, row_sharding
+
+
+def round_cap(n: int) -> int:
+    """Round a per-shard capacity up to a power of two (min 8) to bound
+    the number of distinct compiled shapes."""
+    cap = 8
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _pad_rows(arr: np.ndarray, cap: int) -> np.ndarray:
+    pad = cap - arr.shape[0]
+    if pad <= 0:
+        return arr[:cap]
+    width = ((0, pad),) + tuple((0, 0) for _ in arr.shape[1:])
+    return np.pad(arr, width)
+
+
+@dataclass
+class ShardedKV:
+    """Sharded KV frame: key/value row blocks + per-shard counts."""
+
+    mesh: Mesh
+    key: jax.Array        # [P*cap] or [P*cap, w]
+    value: jax.Array      # [P*cap] or [P*cap, w]
+    counts: np.ndarray    # host [P] int32
+
+    @property
+    def nprocs(self) -> int:
+        return mesh_axis_size(self.mesh)
+
+    @property
+    def cap(self) -> int:
+        return self.key.shape[0] // self.nprocs
+
+    def __len__(self) -> int:
+        return int(self.counts.sum())
+
+    @property
+    def nkv(self) -> int:
+        return len(self)
+
+    def nbytes(self) -> int:
+        return self.key.nbytes + self.value.nbytes
+
+    def is_dense(self) -> bool:
+        return True
+
+    def to_host(self) -> KVFrame:
+        """Compact to an exact host KVFrame (drops padding)."""
+        P, cap = self.nprocs, self.cap
+        k = np.asarray(self.key)
+        v = np.asarray(self.value)
+        keep = np.concatenate([np.arange(i * cap, i * cap + int(self.counts[i]))
+                               for i in range(P)]) if len(self) else \
+            np.zeros(0, np.int64)
+        return KVFrame(DenseColumn(k[keep]), DenseColumn(v[keep]))
+
+    def pairs(self) -> Iterator[Tuple[object, object]]:
+        yield from self.to_host().pairs()
+
+    def __repr__(self):
+        return (f"ShardedKV(P={self.nprocs}, cap={self.cap}, "
+                f"counts={self.counts.tolist()})")
+
+
+@dataclass
+class ShardedKMV:
+    """Sharded KMV frame: per-shard grouped blocks.
+
+    Per shard i: groups ``ukey[i*gcap : i*gcap+gcounts[i]]`` with value runs
+    inside ``values[i*vcap : i*vcap+vcounts[i]]`` located by local
+    ``voffsets`` (offsets are shard-local, i.e. relative to ``i*vcap``)."""
+
+    mesh: Mesh
+    ukey: jax.Array       # [P*gcap(, w)]
+    nvalues: jax.Array    # [P*gcap] int32
+    voffsets: jax.Array   # [P*gcap] int32 (shard-local)
+    values: jax.Array     # [P*vcap(, w)]
+    gcounts: np.ndarray   # host [P]
+    vcounts: np.ndarray   # host [P]
+
+    @property
+    def nprocs(self) -> int:
+        return mesh_axis_size(self.mesh)
+
+    @property
+    def gcap(self) -> int:
+        return self.ukey.shape[0] // self.nprocs
+
+    @property
+    def vcap(self) -> int:
+        return self.values.shape[0] // self.nprocs
+
+    def __len__(self) -> int:
+        return int(self.gcounts.sum())
+
+    @property
+    def nkmv(self) -> int:
+        return len(self)
+
+    @property
+    def nvalues_total(self) -> int:
+        return int(self.vcounts.sum())
+
+    def nbytes(self) -> int:
+        return (self.ukey.nbytes + self.nvalues.nbytes +
+                self.voffsets.nbytes + self.values.nbytes)
+
+    def is_dense(self) -> bool:
+        return True
+
+    def to_host(self) -> KMVFrame:
+        """Compact to an exact host KMVFrame."""
+        P, gcap, vcap = self.nprocs, self.gcap, self.vcap
+        uk = np.asarray(self.ukey)
+        nv = np.asarray(self.nvalues)
+        vo = np.asarray(self.voffsets)
+        vals = np.asarray(self.values)
+        keys, counts, value_rows = [], [], []
+        for i in range(P):
+            g = int(self.gcounts[i])
+            base = i * gcap
+            keys.append(uk[base:base + g])
+            counts.append(nv[base:base + g])
+            for j in range(g):
+                s = i * vcap + int(vo[base + j])
+                value_rows.append(vals[s:s + int(nv[base + j])])
+        key = np.concatenate(keys) if keys else uk[:0]
+        nvalues = (np.concatenate(counts) if counts else
+                   np.zeros(0, np.int64)).astype(np.int64)
+        values = np.concatenate(value_rows) if value_rows else vals[:0]
+        offsets = np.concatenate([[0], np.cumsum(nvalues)]).astype(np.int64)
+        return KMVFrame(DenseColumn(key), nvalues, offsets, DenseColumn(values))
+
+    def groups(self):
+        yield from self.to_host().groups()
+
+    def group_values(self, i: int):
+        return self.to_host().group_values(i)
+
+    def __repr__(self):
+        return (f"ShardedKMV(P={self.nprocs}, gcap={self.gcap}, "
+                f"g={len(self)}, n={self.nvalues_total})")
+
+
+def shard_frame(frame: KVFrame, mesh: Mesh) -> ShardedKV:
+    """Initial block distribution of a host/device KVFrame over the mesh
+    (contiguous split — the analogue of 'each rank mapped its own tasks')."""
+    P = mesh_axis_size(mesh)
+    k = np.asarray(frame.key.data)
+    v = np.asarray(frame.value.data)
+    n = k.shape[0]
+    per = -(-n // P) if n else 0
+    cap = round_cap(per)
+    counts = np.zeros(P, np.int32)
+    kb, vb = [], []
+    for i in range(P):
+        lo, hi = min(i * per, n), min((i + 1) * per, n)
+        counts[i] = hi - lo
+        kb.append(_pad_rows(k[lo:hi], cap))
+        vb.append(_pad_rows(v[lo:hi], cap))
+    sharding = row_sharding(mesh)
+    key = jax.device_put(np.concatenate(kb), sharding)
+    value = jax.device_put(np.concatenate(vb), sharding)
+    return ShardedKV(mesh, key, value, counts)

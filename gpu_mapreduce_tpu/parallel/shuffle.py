@@ -1,0 +1,223 @@
+"""The distributed shuffle — aggregate() over ICI collectives.
+
+Re-designs the reference's ``MapReduce::aggregate`` + ``Irregular`` stack
+(``src/mapreduce.cpp:385-563``, ``src/irregular.cpp``; call stack SURVEY.md
+§3.2) as a two-phase padded all-to-all:
+
+phase 1 (jitted, per shard): hash each valid key to a destination shard
+  (user hash or the lookup3 port — same default as
+  ``hashlittle(key,bytes,nprocs)%nprocs``, src/mapreduce.cpp:469-472),
+  stable-sort rows by destination, count rows per destination.
+
+host: read the [P,P] count matrix, pick the padded bucket size B and the
+  output capacity (rounded to powers of two to bound recompiles).  This
+  replaces the reference's INTMAX/fraction flow-control negotiation
+  (``irregular.cpp:95-242``) — static shapes instead of retry loops.
+
+phase 2 (jitted, per shard): scatter sorted rows into a [P,B] send buffer,
+  exchange via ``lax.all_to_all`` (``all2all=1``) or a ppermute ring
+  (``all2all=0`` — the reference's custom Irecv/Send transport,
+  ``irregular.cpp:311-363``), then compact received rows to the front.
+
+Skew note: padding to the max bucket wastes ICI bandwidth on skewed keys
+(RMAT high-degree vertices); the count matrix is already on the host, so a
+multi-round fixed-budget variant can slot in here later (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.frame import KVFrame
+from ..ops.hash import hash_words32
+from .mesh import AXIS, mesh_axis_size, row_sharding
+from .sharded import ShardedKV, round_cap, shard_frame
+
+# ---------------------------------------------------------------------------
+# hashing of device keys
+# ---------------------------------------------------------------------------
+
+def keys_to_words32(keys):
+    """Bitcast any fixed-width key array [n(,w)] to uint32 words [n, W] so
+    the device hash sees the same little-endian bytes the host hash would
+    (reference hashes raw key bytes)."""
+    if keys.ndim == 1:
+        keys = keys[:, None]
+    nbytes = keys.dtype.itemsize
+    if nbytes >= 4:
+        words = lax.bitcast_convert_type(keys, jnp.uint32)  # [n,w,nb/4]
+        return words.reshape(keys.shape[0], -1)
+    # sub-4-byte dtypes: widen to u32 (hash equals hashlittle on padded bytes)
+    return keys.astype(jnp.uint32).reshape(keys.shape[0], -1)
+
+
+def default_hash(keys):
+    """lookup3 over the key's bytes → uint32 (device twin of
+    hashlittle(key,keybytes,nprocs), src/mapreduce.cpp:472)."""
+    return hash_words32(keys_to_words32(keys))
+
+
+# ---------------------------------------------------------------------------
+# generic two-phase exchange
+# ---------------------------------------------------------------------------
+
+def _phase1(nprocs: int, dest_of: Callable, key, value, count):
+    """Per-shard: dest per row, stable sort rows by dest, per-dest counts.
+    Padding rows get dest=nprocs (dropped later)."""
+    cap = key.shape[0]
+    valid = jnp.arange(cap) < count
+    dest = jnp.where(valid, dest_of(key).astype(jnp.int32), nprocs)
+    order = jnp.argsort(dest, stable=True)
+    skey = jnp.take(key, order, axis=0)
+    svalue = jnp.take(value, order, axis=0)
+    counts_local = jnp.bincount(dest, length=nprocs + 1)[:nprocs].astype(jnp.int32)
+    return skey, svalue, counts_local
+
+
+def _build_send(nprocs: int, B: int, rows, counts_local):
+    """Scatter dest-sorted rows into a [P, B, ...] send buffer."""
+    cap = rows.shape[0]
+    cum = jnp.cumsum(counts_local)
+    r = jnp.arange(cap)
+    d = jnp.searchsorted(cum, r, side="right").astype(jnp.int32)  # dest of row r
+    off = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1].astype(jnp.int32)])
+    q = r - jnp.take(off, jnp.minimum(d, nprocs - 1))
+    shape = (nprocs, B) + rows.shape[1:]
+    send = jnp.zeros(shape, rows.dtype)
+    # rows with d==nprocs (padding) fall out of range → dropped
+    return send.at[d, q].set(rows, mode="drop")
+
+
+def _exchange_counts(counts_local, transport: int):
+    """Exchange per-dest counts: counts_from[j] = rows shard j sends me."""
+    nprocs = counts_local.shape[0]
+    if transport == 1:
+        return lax.all_to_all(counts_local[:, None], AXIS, 0, 0)[:, 0]
+    me = lax.axis_index(AXIS)
+    counts_from = jnp.zeros_like(counts_local)
+    counts_from = counts_from.at[me].set(counts_local[me])
+    for k in range(1, nprocs):
+        perm = [(i, (i + k) % nprocs) for i in range(nprocs)]
+        cnt = jnp.take(counts_local, (me + k) % nprocs)
+        counts_from = counts_from.at[(me - k) % nprocs].set(
+            lax.ppermute(cnt, AXIS, perm))
+    return counts_from
+
+
+def _exchange_blocks(send, transport: int):
+    """[P,B,...] send blocks → [P,B,...] recv blocks."""
+    nprocs = send.shape[0]
+    if transport == 1:
+        return lax.all_to_all(send, AXIS, 0, 0)
+    # ppermute ring (the reference's pre-posted Irecv/Send transport)
+    me = lax.axis_index(AXIS)
+    recv = jnp.zeros_like(send)
+    recv = recv.at[me].set(send[me])  # self-copy overlap (irregular.cpp:311)
+    for k in range(1, nprocs):
+        perm = [(i, (i + k) % nprocs) for i in range(nprocs)]
+        blk = jnp.take(send, (me + k) % nprocs, axis=0)
+        recv = recv.at[(me - k) % nprocs].set(lax.ppermute(blk, AXIS, perm))
+    return recv
+
+
+def _compact(recv, counts_from, cap_out: int):
+    """[P,B,...] recv blocks → [cap_out,...] rows packed to the front."""
+    nprocs, B = recv.shape[0], recv.shape[1]
+    flat = recv.reshape((nprocs * B,) + recv.shape[2:])
+    valid = (jnp.arange(B)[None, :] < counts_from[:, None]).reshape(-1)
+    order = jnp.argsort(~valid, stable=True)  # valid rows first, order kept
+    packed = jnp.take(flat, order[:cap_out], axis=0)
+    return packed, jnp.sum(counts_from)
+
+
+def exchange(skv: ShardedKV, dest_of: Callable, transport: int = 1,
+             counters=None) -> ShardedKV:
+    """Full ragged exchange: route every valid row to dest_of(keys) shard."""
+    mesh = skv.mesh
+    nprocs = mesh_axis_size(mesh)
+    spec_rows, spec_cnt = P(AXIS), P(AXIS)
+
+    @functools.partial(jax.jit)
+    def phase1(key, value, count):
+        f = functools.partial(_phase1, nprocs, dest_of)
+        return jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(spec_rows, spec_rows, spec_cnt),
+            out_specs=(spec_rows, spec_rows, spec_cnt))(key, value, count)
+
+    counts_dev = jax.device_put(skv.counts.astype(np.int32),
+                                row_sharding(mesh))
+    skey, svalue, counts_local = phase1(skv.key, skv.value, counts_dev)
+    counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
+    B = round_cap(int(counts_mat.max())) if counts_mat.max() else 8
+    new_counts = counts_mat.sum(axis=0).astype(np.int32)
+    cap_out = round_cap(int(new_counts.max())) if new_counts.max() else 8
+
+    def phase2_fn(skey, svalue, counts_local):
+        def body(k, v, cl):
+            counts_from = _exchange_counts(cl, transport)
+            recv_k = _exchange_blocks(_build_send(nprocs, B, k, cl), transport)
+            recv_v = _exchange_blocks(_build_send(nprocs, B, v, cl), transport)
+            out_k, _ = _compact(recv_k, counts_from, cap_out)
+            out_v, _ = _compact(recv_v, counts_from, cap_out)
+            return out_k, out_v
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(spec_rows, spec_rows, spec_cnt),
+            out_specs=(spec_rows, spec_rows))(skey, svalue, counts_local)
+
+    out_k, out_v = jax.jit(phase2_fn)(skey, svalue, counts_local)
+    if counters is not None:
+        rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
+                    skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
+        moved = int(counts_mat.sum() - np.trace(counts_mat)) * rowbytes
+        counters.cssize += moved
+        counters.crsize += moved
+    return ShardedKV(mesh, out_k, out_v, new_counts)
+
+
+# ---------------------------------------------------------------------------
+# aggregate()
+# ---------------------------------------------------------------------------
+
+def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
+    """MapReduce.aggregate on the mesh backend: shard-if-needed, then
+    hash-exchange.  Host byte-string data cannot shard (intern first —
+    SURVEY.md §7); it stays controller-resident with a warning."""
+    from ..core.runtime import Timer
+    kv = mr.kv
+    frame = kv.one_frame()
+    if isinstance(frame, KVFrame):
+        if not frame.is_dense():
+            mr.error.warning(
+                "aggregate: byte-string KV stays host-resident; intern keys "
+                "to u64 (BytesColumn.intern) for the device shuffle")
+            return
+        skv = shard_frame(frame, backend.mesh)
+    else:
+        skv = frame  # already sharded
+    nprocs = backend.nprocs
+    if hash_fn is not None:
+        dest_of = lambda keys: hash_fn(keys) % nprocs
+    else:
+        dest_of = lambda keys: default_hash(keys) % nprocs
+    t = Timer()
+    out = exchange(skv, dest_of, transport=mr.settings.all2all,
+                   counters=mr.counters)
+    mr.counters.commtime += t.elapsed()
+    _replace_kv_frames(kv, out)
+
+
+def _replace_kv_frames(kv, sharded_frame):
+    kv.free()
+    kv._frames = [sharded_frame]
+    kv.counters.mem(sharded_frame.nbytes())
+    kv.nkv = len(sharded_frame)
+    kv.complete_done = True

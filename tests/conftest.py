@@ -18,6 +18,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# the axon TPU plugin's register() forces jax_platforms="axon,cpu" via
+# jax.config, which beats the env var — force it back to cpu for tests
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
