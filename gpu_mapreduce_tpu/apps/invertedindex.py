@@ -1,0 +1,181 @@
+"""InvertedIndex — the reference's flagship GPU application, TPU-native.
+
+Pipeline (reference ``cuda/InvertedIndex.cu:140-202``, call stack SURVEY.md
+§3.6): per HTML file, find every ``<a href="..."`` URL (device kernels),
+emit (url, filename) pairs; ``aggregate`` shuffles URLs across chips;
+``convert`` groups; ``reduce`` writes ``url \\t file file...`` lines to
+per-proc output files (``:463-513``).
+
+Device stages (Pallas/XLA, ops/pallas/match.py): mark → compact →
+url_lengths.  The host loop then interns URL bytes to u64 ids and bulk-adds
+(url_id, doc_id) — the analogue of the reference's host ``kv->add`` loop
+(``:385-388``), but batched.  File *names* are u32 doc ids into a host
+table, not repeated strings.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.mapreduce import MapReduce
+from ..ops.hash import hash_bytes64
+from ..ops.pallas.match import url_lengths
+from ..utils.io import findfiles
+
+PATTERN = b'<a href="'
+QUOTE = ord('"')
+MAX_URL = 1024
+
+
+CHUNK = 1 << 26            # 64 MB — the reference's per-chunk unit
+MIN_CHUNK = 1 << 17        # small files pad to pow2 ≥ 128 KB
+OVERLAP = len(PATTERN) + MAX_URL
+
+
+@functools.lru_cache(maxsize=None)
+def _mark_count_fn(pattern: bytes, use_pallas: bool, interpret: bool):
+    """Compiled (per chunk-shape, cached) mark+count.  The buffer is
+    chunk+overlap bytes; matches starting in the overlap tail belong to the
+    next chunk and are masked off."""
+
+    @jax.jit
+    def run(buf, nvalid):
+        from ..ops.pallas.match import mark_pallas, mark_xla
+        mask = (mark_pallas(buf, pattern, interpret=interpret) if use_pallas
+                else mark_xla(buf, pattern))
+        own = jnp.arange(buf.shape[0]) < nvalid
+        mask = jnp.where(own, mask.astype(jnp.int32), 0)
+        return mask, jnp.sum(mask)
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _compact_len_fn(cap: int):
+    @jax.jit
+    def run(buf, mask):
+        from ..ops.pallas.match import compact_matches
+        starts, _ = compact_matches(mask, cap)
+        starts = starts + len(PATTERN)
+        lengths, _ = url_lengths(buf, starts, QUOTE, MAX_URL)
+        return starts, lengths
+
+    return run
+
+
+def _chunk_iter(data: np.ndarray):
+    """Yield (padded chunk+overlap buffer, base offset, valid bytes)."""
+    n = len(data)
+    chunk = MIN_CHUNK
+    while chunk < min(n, CHUNK):
+        chunk <<= 1
+    for base in range(0, n, chunk):
+        nvalid = min(chunk, n - base)
+        buf = np.zeros(chunk + OVERLAP, np.uint8)
+        take = min(chunk + OVERLAP, n - base)
+        buf[:take] = data[base:base + take]
+        yield buf, base, nvalid
+
+
+def _device_extract(data: np.ndarray, use_pallas: bool, interpret: bool):
+    """One file's bytes → (starts, lengths) host arrays, chunked through
+    shape-cached compiled kernels (one compile per pow2 chunk size)."""
+    all_starts, all_lengths = [], []
+    for buf_np, base, nvalid in _chunk_iter(data):
+        buf = jnp.asarray(buf_np)
+        mask, nhits = _mark_count_fn(PATTERN, use_pallas, interpret)(buf, nvalid)
+        nhits = int(nhits)
+        if nhits == 0:
+            continue
+        cap = max(8, 1 << (nhits - 1).bit_length())
+        starts, lengths = _compact_len_fn(cap)(buf, mask)
+        all_starts.append(np.asarray(starts[:nhits], np.int64) + base)
+        all_lengths.append(np.asarray(lengths[:nhits]))
+    if not all_starts:
+        return np.zeros(0, np.int64), np.zeros(0, np.int32)
+    return np.concatenate(all_starts), np.concatenate(all_lengths)
+
+
+class InvertedIndex:
+    """Builds an inverted URL→documents index over the MapReduce algebra."""
+
+    def __init__(self, comm=None, use_pallas: Optional[bool] = None,
+                 interpret: Optional[bool] = None):
+        backend = jax.default_backend()
+        if use_pallas is None:
+            use_pallas = True
+        if interpret is None:
+            interpret = backend != "tpu"  # CPU tests interpret the kernel
+        self.use_pallas = use_pallas
+        self.interpret = interpret
+        self.comm = comm
+        self.urls: Dict[int, bytes] = {}
+        self.docs: List[str] = []
+        self.npairs = 0
+
+    # -- map stage -------------------------------------------------------
+    def _map_file(self, itask, filename, kv, ptr):
+        with open(filename, "rb") as f:
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        doc_id = len(self.docs)
+        self.docs.append(filename)
+        if len(data) == 0:
+            return
+        starts, lengths = _device_extract(data, self.use_pallas, self.interpret)
+        ids = np.empty(len(starts), np.uint64)
+        keep = np.ones(len(starts), bool)
+        for i, (st, ln) in enumerate(zip(starts, lengths)):
+            if ln < 0:
+                keep[i] = False  # unterminated href — reference runs off; we drop
+                continue
+            url = data[st:st + ln].tobytes()  # slice from the host buffer
+            h = hash_bytes64(url)
+            prev = self.urls.get(h)
+            if prev is not None and prev != url:
+                raise ValueError(f"64-bit URL intern collision: {prev!r} vs {url!r}")
+            self.urls[h] = url
+            ids[i] = h
+        kv.add_batch(ids[keep],
+                     np.full(int(keep.sum()), doc_id, dtype=np.uint32))
+
+    # -- full pipeline ---------------------------------------------------
+    def run(self, paths: Sequence[str], outdir: Optional[str] = None,
+            nfiles: Optional[int] = None) -> Tuple[int, int]:
+        """Returns (total hits, unique urls).  Writes `url \\t files` lines
+        to outdir/part-<proc> when outdir is given (reference myreduce,
+        cuda/InvertedIndex.cu:463-513)."""
+        mr = MapReduce(self.comm)
+        files = findfiles(list(paths))
+        if nfiles is not None:
+            files = files[:nfiles]
+        self.npairs = mr.map_files(files, self._map_file)
+        mr.aggregate()
+        mr.convert()
+
+        out = None
+        nurl = [0]
+
+        def emit(key, values, kv, ptr):
+            nurl[0] += 1
+            if out is not None:
+                url = self.urls[int(key)].decode(errors="replace")
+                names = " ".join(self.docs[int(v)] for v in sorted(set(values)))
+                out.write(f"{url}\t{names}\n")
+            kv.add(key, len(values))
+
+        try:
+            if outdir:
+                os.makedirs(outdir, exist_ok=True)
+                out = open(os.path.join(outdir, "part-00000"), "w")
+            mr.reduce(emit)
+        finally:
+            if out is not None:
+                out.close()
+        self.mr = mr
+        return self.npairs, nurl[0]

@@ -1,0 +1,143 @@
+"""Pallas substring matching — the TPU twin of the CUDA ``mark`` kernel.
+
+The reference marks every occurrence of ``<a href="`` in an HTML buffer with
+a 0/1 segmask via a 9-char stencil compare on the GPU
+(``cuda/InvertedIndex.cu:79-107``), then compacts the mask with Thrust
+(``:321-362``) and scans each hit forward to the closing quote
+(``compute_url_length``, ``:109-135``).
+
+TPU re-design: the byte buffer is laid out ``[rows, 128]`` (one byte per
+lane, widened to int32 in VMEM — the VPU has no sub-word lanes).  For each
+pattern offset j the shifted view ``x[i+j]`` is assembled from two
+``pltpu.roll``s (same-row lane roll + next-row carry), and the stencil
+compare ANDs across offsets.  One kernel pass over the buffer produces the
+match mask; compaction and length-scan stay in XLA (`jnp.nonzero` /
+windowed gather), where fusion already does the right thing.
+
+``mark_xla`` is the compiler-twin used for CPU tests and as a fallback —
+bit-identical output by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANES = 128
+BLOCK_ROWS = 256  # 32 KB of bytes per grid step
+
+
+def _i32(x: int):
+    """Index-map constants must stay i32: under jax_enable_x64 a bare python
+    int traces as i64, which Mosaic refuses to return from an index map."""
+    return np.int32(x)
+
+
+def _pad_to(buf: jnp.ndarray, mult: int) -> jnp.ndarray:
+    n = buf.shape[0]
+    pad = (-n) % mult
+    if pad:
+        buf = jnp.concatenate([buf, jnp.zeros(pad, buf.dtype)])
+    return buf
+
+
+def mark_xla(buf, pattern: bytes):
+    """Reference implementation: mask[i]=1 iff pattern starts at byte i.
+    Nine shifted compares; XLA fuses them into one elementwise pass."""
+    n = buf.shape[0]
+    acc = jnp.ones(n, dtype=bool)
+    for j, p in enumerate(pattern):
+        shifted = jnp.concatenate(
+            [buf[j:], jnp.zeros(j, buf.dtype)]) if j else buf
+        acc = acc & (shifted == np.uint8(p))
+    return acc
+
+
+def _mark_kernel(pattern: bytes, buf_ref, nxt_ref, mask_ref):
+    x = buf_ref[:].astype(jnp.int32)                  # [BR, 128]
+    nxt = nxt_ref[0:1].astype(jnp.int32)              # next block's first row
+    # next-row view of x (row r+1; last row fed by the next block's head)
+    from jax.experimental.pallas import tpu as pltpu
+    # pltpu.roll requires non-negative shifts: roll by (size - j) ≡ roll by -j
+    # (shifts as np.int32 — x64 mode would make a weak i64 that mosaic rejects)
+    xr = pltpu.roll(x, np.int32(x.shape[0] - 1), axis=0)
+    xr = jnp.where(jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+                   == x.shape[0] - 1, nxt, xr)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    acc = jnp.ones(x.shape, dtype=jnp.bool_)
+    for j, p in enumerate(pattern):
+        if j == 0:
+            shifted = x
+        else:
+            a = pltpu.roll(x, np.int32(LANES - j), axis=1)   # x[r, c+j mod 128]
+            b = pltpu.roll(xr, np.int32(LANES - j), axis=1)  # x[r+1, c+j mod 128]
+            shifted = jnp.where(lane < LANES - j, a, b)
+        acc = acc & (shifted == p)
+    mask_ref[:] = acc.astype(jnp.int8)
+
+
+def mark_pallas(buf, pattern: bytes, interpret: bool = False):
+    """Pallas mark kernel over a uint8 buffer [n] → int8 mask [n]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = buf.shape[0]
+    blk = BLOCK_ROWS * LANES
+    buf_p = _pad_to(buf, blk)
+    rows = buf_p.shape[0] // LANES
+    grid = rows // BLOCK_ROWS
+    # one extra zero block so the "next block head" index map stays in range
+    buf_2d = jnp.concatenate(
+        [buf_p.reshape(rows, LANES),
+         jnp.zeros((BLOCK_ROWS, LANES), buf_p.dtype)])
+    out = pl.pallas_call(
+        functools.partial(_mark_kernel, pattern),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, _i32(0)),
+                         memory_space=pltpu.VMEM),
+            # 8-row block (TPU min sublane tile); kernel uses its first row
+            pl.BlockSpec((8, LANES),
+                         lambda i: ((i + _i32(1)) * _i32(BLOCK_ROWS // 8),
+                                    _i32(0)),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, _i32(0)),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(buf_2d, buf_2d)
+    return out.reshape(-1)[:n]
+
+
+def compact_matches(mask, max_hits: int):
+    """Mask → sorted start offsets [max_hits] (fill = len(mask)) + count.
+    The Thrust sequence/count/copy_if stage (cuda/InvertedIndex.cu:321-362)
+    collapses to one jnp.nonzero."""
+    n = mask.shape[0]
+    idx = jnp.nonzero(mask.astype(bool), size=max_hits, fill_value=n)[0]
+    return idx, jnp.sum(mask.astype(jnp.int32))
+
+
+def url_lengths(buf, starts, terminator: int, max_len: int):
+    """For each start offset, distance to the terminator byte (the
+    compute_url_length kernel, cuda/InvertedIndex.cu:109-135).
+
+    Returns lengths [k] (-1 if no terminator within max_len — the reference
+    would run off the buffer; we flag and let the caller drop) and the
+    gathered windows [k, max_len].  A length of 0 is a real empty URL
+    (``href=""``), distinct from the no-terminator case."""
+    n = buf.shape[0]
+    pos = starts[:, None] + jnp.arange(max_len)[None, :]
+    windows = jnp.take(buf, jnp.minimum(pos, n - 1), axis=0)
+    windows = jnp.where(pos < n, windows, 0)
+    hit = windows == np.uint8(terminator)
+    any_hit = jnp.any(hit, axis=1)
+    length = jnp.where(any_hit, jnp.argmax(hit, axis=1), -1)
+    return length.astype(jnp.int32), windows
+
+

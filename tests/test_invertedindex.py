@@ -1,0 +1,135 @@
+"""InvertedIndex pipeline vs a regex oracle; mark kernel (pallas interpret +
+xla twin) equivalence."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex, PATTERN
+from gpu_mapreduce_tpu.ops.pallas.match import (compact_matches, mark_pallas,
+                                                mark_xla, url_lengths)
+
+HTML = (b'<html><body><a href="http://a.com/x">x</a>'
+        b'<p>no link</p><a href="http://b.org/long/path?q=1">y</a>'
+        b'<A HREF="http://case.sensitive/">skip</A>'
+        b'<a href="http://a.com/x">dup</a></body></html>')
+
+
+def oracle_urls(data: bytes):
+    return re.findall(rb'<a href="([^"]*)"', data)
+
+
+def test_mark_xla_vs_pallas_interpret():
+    rng = np.random.default_rng(0)
+    noise = rng.integers(0, 256, size=100_000, dtype=np.uint8)
+    data = noise.tobytes() + HTML * 7 + noise.tobytes()
+    buf = jnp.asarray(np.frombuffer(data, np.uint8))
+    m1 = np.asarray(mark_xla(buf, PATTERN))
+    m2 = np.asarray(mark_pallas(buf, PATTERN, interpret=True))
+    np.testing.assert_array_equal(m1.astype(np.int8), m2)
+    # ground truth from python
+    expect = np.zeros(len(data), np.int8)
+    start = 0
+    while True:
+        i = data.find(PATTERN, start)
+        if i < 0:
+            break
+        expect[i] = 1
+        start = i + 1
+    np.testing.assert_array_equal(m2, expect)
+
+
+def test_mark_cross_lane_boundaries():
+    # place the pattern at every offset mod 128+rows to cross lane/row edges
+    for off in (0, 1, 119, 120, 126, 127, 128, 255, 256, 1000):
+        data = b"x" * off + b'<a href="u">' + b"y" * 300
+        buf = jnp.asarray(np.frombuffer(data, np.uint8))
+        m = np.asarray(mark_pallas(buf, PATTERN, interpret=True))
+        assert m.sum() == 1 and m[off] == 1, off
+
+
+def test_compact_and_lengths():
+    data = HTML
+    buf = jnp.asarray(np.frombuffer(data, np.uint8))
+    mask = mark_xla(buf, PATTERN)
+    starts, n = compact_matches(mask.astype(jnp.int8), 16)
+    assert int(n) == 3  # lowercase '<a href="' occurrences
+    starts = starts + len(PATTERN)
+    lengths, windows = url_lengths(buf, starts, ord('"'), 128)
+    urls = [bytes(np.asarray(windows[i][: int(lengths[i])]))
+            for i in range(int(n))]
+    assert urls == oracle_urls(data)
+
+
+def test_unterminated_href_dropped(tmp_path):
+    f = tmp_path / "bad.html"
+    f.write_bytes(b'<a href="http://ok/">fine</a><a href="no-close-quote')
+    ii = InvertedIndex()
+    nhits, nurl = ii.run([str(f)])
+    assert nhits == 1 and nurl == 1
+    assert list(ii.urls.values()) == [b"http://ok/"]
+
+
+def test_empty_href_kept(tmp_path):
+    # length 0 is a real empty URL, distinct from "no terminator"
+    f = tmp_path / "e.html"
+    f.write_bytes(b'<a href="">empty</a><a href="http://x/">x</a>')
+    ii = InvertedIndex()
+    nhits, nurl = ii.run([str(f)])
+    assert (nhits, nurl) == (2, 2)
+    assert sorted(ii.urls.values()) == [b"", b"http://x/"]
+
+
+@pytest.fixture
+def html_corpus(tmp_path):
+    rng = np.random.default_rng(7)
+    hosts = [b"http://site%d.org/p%d" % (i % 5, i) for i in range(40)]
+    files = []
+    for fi in range(6):
+        parts = [b"<html>"]
+        for _ in range(rng.integers(5, 30)):
+            u = hosts[rng.integers(0, len(hosts))]
+            parts.append(b'<a href="' + u + b'">link</a>' +
+                         bytes(rng.integers(32, 127, size=50, dtype=np.uint8)))
+        parts.append(b"</html>")
+        p = tmp_path / f"part-{fi:05d}.html"
+        p.write_bytes(b"".join(parts))
+        files.append(str(p))
+    return files
+
+
+def test_pipeline_matches_regex_oracle(html_corpus, tmp_path):
+    import collections
+
+    index = collections.defaultdict(set)
+    total = 0
+    for f in html_corpus:
+        data = open(f, "rb").read()
+        for u in oracle_urls(data):
+            index[u].add(f)
+            total += 1
+    ii = InvertedIndex()
+    outdir = str(tmp_path / "out")
+    nhits, nurl = ii.run(html_corpus, outdir=outdir)
+    assert nhits == total
+    assert nurl == len(index)
+    # output file lines reconstruct the oracle index
+    got = {}
+    with open(f"{outdir}/part-00000") as fh:
+        for line in fh:
+            url, names = line.rstrip("\n").split("\t")
+            got[url.encode()] = set(names.split(" "))
+    assert got == dict(index)
+
+
+def test_pipeline_on_mesh(html_corpus):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    ii1 = InvertedIndex()
+    n1 = ii1.run(html_corpus)
+    ii2 = InvertedIndex(comm=make_mesh())
+    n2 = ii2.run(html_corpus)
+    assert n1 == n2
