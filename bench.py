@@ -1,0 +1,88 @@
+"""Driver benchmark: InvertedIndex KV-pairs/sec on one chip.
+
+Workload: the reference's flagship CUDA app (``cuda/InvertedIndex.cu``) —
+scan HTML for ``<a href="`` URLs (device mark/compact/length kernels), emit
+(url, doc) pairs, shuffle, group, count.  Corpus is synthetic deterministic
+HTML (~1 URL per KB, the PUMA-style density).
+
+Baseline: the reference's own in-code stage timings per 64 MB chunk on its
+GPU — mark 4 ms + copy_if 14 ms + compute_url_length 8 ms + host kv->add
+18 ms = 44 ms (``cuda/InvertedIndex.cu:337,360,369,384``), i.e. 1.45 GB/s
+map-stage throughput.  ``vs_baseline`` is our end-to-end bytes/sec over
+that.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+BASELINE_BYTES_PER_SEC = (64 << 20) / 0.044  # reference 64MB/44ms
+
+
+def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4):
+    """Deterministic synthetic HTML: filler with a URL every ~1KB."""
+    per_file = (total_mb << 20) // nfiles
+    filler = b"<p>" + b"lorem ipsum dolor sit amet " * 36 + b"</p>\n"  # ~1KB
+    paths = []
+    uid = 0
+    for i in range(nfiles):
+        pieces = []
+        size = 0
+        while size < per_file:
+            url = b'<a href="http://example.org/wiki/page-%08d">x</a>' % uid
+            uid += 1
+            pieces.append(filler)
+            pieces.append(url)
+            size += len(filler) + len(url)
+        path = os.path.join(tmpdir, f"part-{i:05d}.html")
+        with open(path, "wb") as f:
+            f.write(b"".join(pieces))
+        paths.append(path)
+    return paths, uid
+
+
+def main():
+    total_mb = int(os.environ.get("BENCH_MB", "64"))
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        paths, nurls = make_corpus(tmpdir, total_mb)
+        nbytes = sum(os.path.getsize(p) for p in paths)
+
+        # warmup compile on a small prefix so the timed run measures steady
+        # state (first XLA compile is ~20-40s on TPU)
+        warm = InvertedIndex()
+        warm.run([paths[0]], nfiles=1)
+
+        idx = InvertedIndex()
+        t0 = time.perf_counter()
+        npairs, nunique = idx.run(paths)
+        dt = time.perf_counter() - t0
+
+    assert npairs == nurls, (npairs, nurls)
+    pairs_per_sec = npairs / dt
+    bytes_per_sec = nbytes / dt
+    result = {
+        "metric": "invertedindex_kv_pairs_per_sec_per_chip",
+        "value": round(pairs_per_sec, 1),
+        "unit": "pairs/sec",
+        "vs_baseline": round(bytes_per_sec / BASELINE_BYTES_PER_SEC, 4),
+    }
+    extra = {
+        "npairs": npairs, "nunique": nunique, "bytes": nbytes,
+        "seconds": round(dt, 3),
+        "bytes_per_sec": round(bytes_per_sec, 1),
+        "backend": __import__("jax").default_backend(),
+    }
+    print(json.dumps(result))
+    print(json.dumps({"detail": extra}), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
