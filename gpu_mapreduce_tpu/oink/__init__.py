@@ -1,0 +1,9 @@
+"""OINK — the scripting/command layer over the MapReduce algebra
+(reference ``oink/``, SURVEY.md §2.4-2.5)."""
+
+from .command import COMMANDS, Command, command, run_command
+from .objects import InputDescriptor, ObjectManager, OutputDescriptor
+from . import commands  # registers the built-in command suite
+
+__all__ = ["COMMANDS", "Command", "command", "run_command",
+           "ObjectManager", "InputDescriptor", "OutputDescriptor"]

@@ -1,0 +1,83 @@
+"""Command base class + registry.
+
+The reference registers commands at build time: ``oink/Make.py`` regex-scans
+headers for ``CommandStyle(name,Class)`` macros and generates
+``style_command.h`` (SURVEY.md §2.4).  The Python-native equivalent is a
+decorator registry — same plugin model, no codegen.
+
+A command declares ``ninputs``/``noutputs`` and implements
+``params(args)`` + ``run()`` (reference ``oink/command.{h,cpp}``); it talks
+to data through ``self.obj`` (the ObjectManager), exactly like the
+reference's ``obj->input/output/create_mr/cleanup`` protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from ..core.runtime import MRError
+from .objects import ObjectManager
+
+COMMANDS: Dict[str, Type["Command"]] = {}
+
+
+def command(name: str):
+    """Register a Command subclass (the CommandStyle macro)."""
+    def deco(cls):
+        cls.name = name
+        COMMANDS[name] = cls
+        return cls
+    return deco
+
+
+class Command:
+    name: str = ""
+    ninputs = 0
+    noutputs = 0
+
+    def __init__(self, obj: ObjectManager, screen=None):
+        self.obj = obj
+        self.screen = screen  # None → print to stdout
+        self.result_msg = ""
+
+    # -- overridables ------------------------------------------------------
+    def params(self, args: List[str]):
+        if args:
+            raise MRError(f"Illegal {self.name} command")
+
+    def run(self):
+        raise NotImplementedError
+
+    # -- helpers -----------------------------------------------------------
+    def message(self, msg: str):
+        """Result message (reference error->message on rank 0)."""
+        self.result_msg = msg
+        if self.screen is None:
+            print(msg)
+        elif self.screen is not False:
+            self.screen.write(msg + "\n")
+
+
+def run_command(name: str, args: List[str] = (), obj: ObjectManager = None,
+                inputs=(), outputs=(), screen=None) -> Command:
+    """Programmatic command invocation (what the script interpreter and
+    tests call).  ``inputs``: path-or-MR per -i slot; ``outputs``:
+    (path, mr_name) tuples per -o slot."""
+    if name not in COMMANDS:
+        raise MRError(f"unknown command {name!r}")
+    if obj is None:
+        obj = ObjectManager()
+    cmd = COMMANDS[name](obj, screen=screen)
+    cmd.params(list(args))
+    for src in inputs:
+        obj.add_input(src)
+    for out in outputs:
+        if isinstance(out, tuple):
+            obj.add_output(*out)
+        else:
+            obj.add_output(path=out)
+    try:
+        cmd.run()
+    finally:
+        obj.cleanup()  # a failed run must not leak descriptors/temps
+    return cmd

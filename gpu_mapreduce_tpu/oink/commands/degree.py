@@ -1,0 +1,143 @@
+"""degree / degree_stats / degree_weight commands.
+
+Reference: ``oink/degree.cpp:36-75`` (vertex degree counts),
+``oink/degree_stats.cpp:35-64`` (degree histogram via invert→count),
+``oink/degree_weight.cpp:28-100`` (1/degree edge weights from a degree file
++ edge file)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.runtime import MRError
+from ..command import Command, command
+from ..kernels import (count, edge_to_vertex, edge_to_vertices,
+                       print_edge_value, print_vertex_value, read_edge,
+                       read_vertex_weight, value_histogram)
+
+
+@command("degree")
+class Degree(Command):
+    """degree dupflag: dupflag=1 ⇒ edge list already holds both directions
+    (count Vi only); else count both endpoints (oink/degree.cpp:46-49)."""
+
+    ninputs = 1
+    noutputs = 1
+
+    def params(self, args):
+        if len(args) != 1:
+            raise MRError("Illegal degree command")
+        self.duplicate = int(args[0])
+
+    def run(self):
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+        mrv = obj.create_mr()
+        nedge = mre.kv_stats(0)[0]
+        if self.duplicate == 1:
+            mrv.map_mr(mre, edge_to_vertex, batch=True)
+        else:
+            mrv.map_mr(mre, edge_to_vertices, batch=True)
+        mrv.collate()
+        nvert = mrv.reduce(count, batch=True)
+        self.nvert, self.nedge = nvert, nedge
+        obj.output(1, mrv, print_vertex_value)
+        self.message(f"Degree: {nvert} vertices, {nedge} edges")
+        obj.cleanup()
+
+
+@command("degree_stats")
+class DegreeStats(Command):
+    """degree_stats dupflag: degree histogram printed descending
+    (oink/degree_stats.cpp:35-64).  self.stats = [(degree, nvertices)]."""
+
+    ninputs = 1
+
+    def params(self, args):
+        if len(args) != 1:
+            raise MRError("Illegal degree_stats command")
+        self.duplicate = int(args[0])
+
+    def run(self):
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+        mr = obj.create_mr()
+        nedge = mre.kv_stats(0)[0]
+        if self.duplicate == 1:
+            mr.map_mr(mre, edge_to_vertex, batch=True)
+        else:
+            mr.map_mr(mre, edge_to_vertices, batch=True)
+        mr.collate()
+        nvert = mr.reduce(count, batch=True)
+        self.nvert, self.nedge = nvert, nedge
+        self.message(f"DegreeStats: {nvert} vertices, {nedge} edges")
+        self.stats = value_histogram(mr)
+        for degree, nv in self.stats:
+            self.message(f"  {degree} {nv}")
+        obj.cleanup()
+
+
+@command("degree_weight")
+class DegreeWeight(Command):
+    """degree_weight: edges + a 'vertex degree' file → Eij : 1/degree(Vi)
+    (oink/degree_weight.cpp).
+
+    The reference mixes neighbor-id and degree values in one KV and
+    discriminates by valuebytes; columnar frames need one dtype, so we tag
+    rows instead: value = [tag, payload] u64 with tag 0=neighbor, 1=degree
+    — same join, fixed lanes."""
+
+    ninputs = 2
+    noutputs = 1
+
+    def params(self, args):
+        if args:
+            raise MRError("Illegal degree_weight command")
+
+    def run(self):
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+        mrd = obj.input(2, read_vertex_weight)
+        mrewt = obj.create_mr()
+        nvert = mrd.kv_stats(0)[0]
+
+        def edges_tagged(fr, kv, ptr):
+            e = np.asarray(fr.key.to_host().data)
+            val = np.stack([np.zeros(len(e), np.uint64), e[:, 1]], 1)
+            kv.add_batch(e[:, 0], val)
+
+        def degrees_tagged(fr, kv, ptr):
+            v = np.asarray(fr.key.to_host().data)
+            d = np.asarray(fr.value.to_host().data).astype(np.uint64)
+            val = np.stack([np.ones(len(v), np.uint64), d], 1)
+            kv.add_batch(v, val)
+
+        mrewt.map_mr(mre, edges_tagged, batch=True)
+        tmp = obj.create_mr()
+        tmp.map_mr(mrd, degrees_tagged, batch=True)
+        mrewt.add(tmp)
+        mrewt.collate()
+
+        def inverse_degree(fr, kv, ptr):
+            vals = np.asarray(fr.values.to_host().data)   # [n, 2]
+            keys = np.asarray(fr.key.to_host().data)      # [g] u64
+            seg = np.repeat(np.arange(len(fr)), fr.nvalues)
+            deg = np.zeros(len(fr), np.float64)
+            isdeg = vals[:, 0] == 1
+            deg[seg[isdeg]] = vals[isdeg, 1].astype(np.float64)
+            nb = ~isdeg
+            if np.any(deg[seg[nb]] == 0):
+                missing = np.unique(keys[seg[nb]][deg[seg[nb]] == 0])
+                raise MRError(
+                    f"degree_weight: {len(missing)} edge source vertices "
+                    f"missing from the degree file (e.g. {missing[0]})")
+            vi = keys[seg[nb]]
+            vj = vals[nb, 1]
+            w = 1.0 / deg[seg[nb]]
+            kv.add_batch(np.stack([vi, vj], 1), w)
+
+        nedge = mrewt.reduce(inverse_degree, batch=True)
+        self.nvert, self.nedge = nvert, nedge
+        obj.output(1, mrewt, print_edge_value)
+        self.message(f"DegreeWeight: {nvert} vertices, {nedge} edges")
+        obj.cleanup()

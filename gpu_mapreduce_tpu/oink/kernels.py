@@ -1,0 +1,163 @@
+"""Shared OINK kernels — the reusable map/reduce callbacks of
+``oink/map_*.cpp`` / ``oink/reduce_*.cpp``, batch-first.
+
+Data conventions (reference ``oink/typedefs.h:22-40``):
+
+* VERTEX = uint64 → a ``[n]`` u64 column;
+* EDGE = {vi, vj} → a ``[n, 2]`` u64 column (struct-of-rows, fixed width —
+  the TPU fast path, SURVEY.md §7);
+* WEIGHT = float64 → ``[n]`` f64 column;
+* NULL values → ``[n]`` u8 zeros.
+
+Every kernel here is a *batch* callback (``mr.map_mr(..., batch=True)`` /
+``mr.reduce(..., batch=True)``): it receives a whole KVFrame/KMVFrame and
+emits columns, so pipelines stay vectorised end-to-end.  Host per-pair
+equivalents are what the reference runs; the semantics match 1:1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.frame import KVFrame
+
+# ---------------------------------------------------------------------------
+# file parsers (reference map_read_*.cpp — host I/O, vectorised parse)
+# ---------------------------------------------------------------------------
+
+
+def _null(n: int) -> np.ndarray:
+    return np.zeros(n, np.uint8)
+
+
+def _parse_cols(filename: str, dtypes) -> list:
+    """Whitespace table → one exact-dtype array per column (u64 vertex ids
+    parse as integers, never through float — ids ≥ 2^53 stay exact)."""
+    with open(filename, "rb") as f:
+        toks = np.asarray(f.read().split())
+    ncols = len(dtypes)
+    if len(toks) % ncols:
+        raise ValueError(f"{filename}: token count not divisible by {ncols}")
+    table = toks.reshape(-1, ncols)
+    return [table[:, i].astype(dt) for i, dt in enumerate(dtypes)]
+
+
+def read_edge(itask, filename, kv, ptr):
+    """'vi vj' lines → key=[vi,vj], value=NULL (map_read_edge.cpp:15-25)."""
+    vi, vj = _parse_cols(filename, (np.uint64, np.uint64))
+    kv.add_batch(np.stack([vi, vj], 1), _null(len(vi)))
+
+
+def read_edge_weight(itask, filename, kv, ptr):
+    """'vi vj wt' lines → key=[vi,vj], value=weight
+    (map_read_edge_weight.cpp)."""
+    vi, vj, w = _parse_cols(filename, (np.uint64, np.uint64, np.float64))
+    kv.add_batch(np.stack([vi, vj], 1), w)
+
+
+def read_edge_label(itask, filename, kv, ptr):
+    """'vi vj label' lines → key=[vi,vj], value=int label
+    (map_read_edge_label.cpp)."""
+    vi, vj, lab = _parse_cols(filename, (np.uint64, np.uint64, np.int64))
+    kv.add_batch(np.stack([vi, vj], 1), lab)
+
+
+def read_vertex_weight(itask, filename, kv, ptr):
+    """'v weight' lines → key=v, value=weight (map_read_vertex_weight.cpp)."""
+    v, w = _parse_cols(filename, (np.uint64, np.float64))
+    kv.add_batch(v, w)
+
+
+def read_words(itask, filename, kv, ptr):
+    """whitespace words → key=word bytes, value=NULL (map_read_words.cpp)."""
+    with open(filename, "rb") as f:
+        words = f.read().split()
+    if ptr is not None and isinstance(ptr, list):
+        ptr.append(filename)  # nfiles counter (reference int* ptr)
+    kv.add_batch(words, _null(len(words)))
+
+
+# ---------------------------------------------------------------------------
+# edge/vertex maps (batch: fn(frame, kv, ptr))
+# ---------------------------------------------------------------------------
+
+def edge_to_vertices(fr: KVFrame, kv, ptr):
+    """Eij:NULL → Vi:NULL and Vj:NULL (map_edge_to_vertices.cpp)."""
+    e = np.asarray(fr.key.to_host().data)
+    both = np.concatenate([e[:, 0], e[:, 1]])
+    kv.add_batch(both, _null(len(both)))
+
+
+def edge_to_vertex(fr: KVFrame, kv, ptr):
+    """Eij:NULL → Vi:NULL only (map_edge_to_vertex.cpp)."""
+    e = np.asarray(fr.key.to_host().data)
+    kv.add_batch(e[:, 0], _null(len(e)))
+
+
+def edge_to_vertex_pair(fr: KVFrame, kv, ptr):
+    """Eij:NULL → Vi:Vj (map_edge_to_vertex_pair.cpp)."""
+    e = np.asarray(fr.key.to_host().data)
+    kv.add_batch(e[:, 0], e[:, 1])
+
+
+def edge_upper(fr: KVFrame, kv, ptr):
+    """Canonicalise to Vi<Vj, drop self-loops (map_edge_upper.cpp:15-24)."""
+    e = np.asarray(fr.key.to_host().data)
+    keep = e[:, 0] != e[:, 1]
+    e = e[keep]
+    lo = np.minimum(e[:, 0], e[:, 1])
+    hi = np.maximum(e[:, 0], e[:, 1])
+    kv.add_batch(np.stack([lo, hi], 1), _null(len(e)))
+
+
+def invert(fr: KVFrame, kv, ptr):
+    """K:V → V:K (map_invert.cpp)."""
+    kv.add_batch(fr.value, fr.key)
+
+
+def add_weight(fr: KVFrame, kv, ptr):
+    """Eij:NULL → Eij:1.0 (map_add_weight.cpp — unit edge weights)."""
+    kv.add_batch(fr.key, np.ones(len(fr), np.float64))
+
+
+# ---------------------------------------------------------------------------
+# reduces — re-exported from ops/reduces.py, which dispatches on frame kind
+# (local KMVFrame vs mesh ShardedKMV) so commands run on both backends
+# ---------------------------------------------------------------------------
+
+from ..ops.reduces import count, cull, max_values, min_values, sum_values  # noqa: E402,F401
+
+
+def value_histogram(mr) -> list:
+    """The shared histogram tail of histo/degree_stats
+    (oink/histo.cpp:59-66, oink/degree_stats.cpp:52-61): invert to
+    value:key, group, count, gather, sort descending.  Consumes mr's KV;
+    returns [(value, count)] sorted by value descending."""
+    mr.map_mr(mr, invert, batch=True)
+    mr.collate()
+    mr.reduce(count, batch=True)
+    mr.gather(1)
+    mr.sort_keys(-1)
+    stats = []
+    mr.scan_kv(lambda k, v, p: stats.append((int(k), int(v))))
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# printers (reference per-command print callbacks)
+# ---------------------------------------------------------------------------
+
+def print_edge(k, v, fp):
+    fp.write(f"{k[0]} {k[1]}\n")
+
+
+def print_vertex(k, v, fp):
+    fp.write(f"{k}\n")
+
+
+def print_vertex_value(k, v, fp):
+    fp.write(f"{k} {v}\n")
+
+
+def print_edge_value(k, v, fp):
+    fp.write(f"{k[0]} {k[1]} {v}\n")

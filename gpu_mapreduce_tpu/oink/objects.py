@@ -1,0 +1,180 @@
+"""OINK object manager — named/temporary MapReduce objects + I/O descriptors.
+
+Re-designs ``oink/object.{h,cpp}``: the registry of wrapped MR objects that
+commands create, consume, and hand back to the script layer.
+
+* named MRs persist across commands (``mr`` script objects); temporaries
+  from :meth:`create_mr` die at :meth:`cleanup` (``object.cpp`` MRwrap
+  lifecycle, ``oink/object.h:91-98``);
+* input descriptors (``-i`` in scripts, ``oink/object.h:117-155``) are
+  either file path globs (command reads them with a parser callback) or an
+  existing named MR (used directly — commands copy-on-write if permanent,
+  mirroring ``obj->permanent(mr) ⇒ copy_mr``);
+* output descriptors (``-o``) carry a file path (the command's print
+  callback writes it) and/or a name to register the result MR under;
+* per-script MR defaults (the ``set`` command, ``oink/object.h:100-113``):
+  verbosity/timer/memsize/outofcore/minpage/maxpage/freepage/zeropage/
+  fpath applied to every MR the manager creates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from ..core.mapreduce import MapReduce
+from ..core.runtime import MRError
+
+
+@dataclass
+class InputDescriptor:
+    paths: Optional[List[str]] = None     # file/glob mode
+    mr_name: Optional[str] = None         # named-MR mode
+
+
+@dataclass
+class OutputDescriptor:
+    path: Optional[str] = None            # write file via print callback
+    mr_name: Optional[str] = None         # register result as named MR
+
+
+class ObjectManager:
+    """Holds named MRs, temporaries, descriptors, and MR defaults."""
+
+    # settings the `set` script command may override (doc: oinkdoc/set.txt)
+    MR_SETTINGS = ("verbosity", "timer", "memsize", "outofcore", "minpage",
+                   "maxpage", "freepage", "zeropage", "fpath")
+
+    def __init__(self, comm=None):
+        self.comm = comm
+        self.named: Dict[str, MapReduce] = {}
+        self._temps: List[MapReduce] = []
+        self._anon_names: List[str] = []
+        self._anon_counter = 0
+        self.defaults: Dict[str, object] = {}
+        self.inputs: List[InputDescriptor] = []
+        self.outputs: List[OutputDescriptor] = []
+
+    # -- settings ----------------------------------------------------------
+    def set_default(self, name: str, value):
+        if name not in self.MR_SETTINGS:
+            raise MRError(f"unknown set parameter {name!r}")
+        self.defaults[name] = value
+
+    # -- MR lifecycle ------------------------------------------------------
+    def create_mr(self) -> MapReduce:
+        mr = MapReduce(self.comm, **self.defaults)
+        self._temps.append(mr)
+        return mr
+
+    def permanent(self, mr: MapReduce) -> bool:
+        return any(m is mr for m in self.named.values())
+
+    def copy_mr(self, mr: MapReduce) -> MapReduce:
+        cp = mr.copy()
+        self._temps.append(cp)
+        return cp
+
+    def name_mr(self, name: str, mr: MapReduce):
+        self.named[name] = mr
+        self._temps = [m for m in self._temps if m is not mr]
+
+    def get_mr(self, name: str) -> MapReduce:
+        if name not in self.named:
+            raise MRError(f"no MapReduce object named {name!r}")
+        return self.named[name]
+
+    def delete_mr(self, name: str):
+        mr = self.named.pop(name, None)
+        if mr is not None:
+            if mr.kv is not None:
+                mr.kv.free()
+            if mr.kmv is not None:
+                mr.kmv.free()
+
+    def cleanup(self):
+        """Free temporaries and drop anonymous input registrations
+        (reference Object::cleanup).  Anonymous MRs are caller-owned, so
+        only the registry entry is released, not their data."""
+        for mr in self._temps:
+            if mr.kv is not None:
+                mr.kv.free()
+            if mr.kmv is not None:
+                mr.kmv.free()
+        self._temps = []
+        for name in self._anon_names:
+            self.named.pop(name, None)
+        self._anon_names = []
+        self.inputs = []
+        self.outputs = []
+
+    # -- descriptors -------------------------------------------------------
+    def add_input(self, source: Union[str, Sequence[str], MapReduce]):
+        """Add the next -i descriptor: path(s) or a named MR (by name)."""
+        if isinstance(source, MapReduce):
+            self._anon_counter += 1
+            name = f"_anon{self._anon_counter}"
+            self.named[name] = source
+            self._anon_names.append(name)
+            self.inputs.append(InputDescriptor(mr_name=name))
+        elif isinstance(source, str) and source in self.named:
+            self.inputs.append(InputDescriptor(mr_name=source))
+        else:
+            paths = [source] if isinstance(source, str) else list(source)
+            self.inputs.append(InputDescriptor(paths=paths))
+
+    def add_output(self, path: Optional[str] = None,
+                   mr_name: Optional[str] = None):
+        self.outputs.append(OutputDescriptor(path=path, mr_name=mr_name))
+
+    # -- the command-facing protocol (reference obj->input/obj->output) ----
+    def input(self, index: int, parser: Optional[Callable] = None,
+              ptr=None) -> MapReduce:
+        """Resolve -i descriptor #index (1-based).  File mode runs
+        ``parser(itask, filename, kv, ptr)`` over the paths; MR mode
+        returns the named MR as-is (reference oink/object.cpp add_input)."""
+        if index > len(self.inputs):
+            raise MRError(f"command input {index} not provided")
+        d = self.inputs[index - 1]
+        if d.mr_name is not None:
+            return self.get_mr(d.mr_name)
+        if parser is None:
+            raise MRError("file input requires a parser callback")
+        mr = self.create_mr()
+        mr.map_files(d.paths, parser, ptr)
+        return mr
+
+    def output(self, index: int, mr: MapReduce,
+               printer: Optional[Callable] = None, ptr=None):
+        """Handle -o descriptor #index: write ``printer(key, value, fp)``
+        lines to the path if given; register mr under the name if given.
+        Missing descriptor ⇒ no-op (commands always call output; scripts
+        decide, reference oink/object.cpp:237-370)."""
+        if index > len(self.outputs):
+            return
+        d = self.outputs[index - 1]
+        if d.path is not None:
+            with open(d.path, "w") as fp:
+                if printer is None:
+                    mr_dump(mr, fp)
+                else:
+                    for k, v in _iter_pairs(mr):
+                        printer(k, v, fp)
+        if d.mr_name is not None:
+            self.name_mr(d.mr_name, mr)
+
+
+def _iter_pairs(mr: MapReduce):
+    """Yield (key, value) per KV pair, or (key, [values]) per KMV group when
+    the MR holds a KMV (e.g. neighbor's adjacency lists)."""
+    if mr.kv is not None:
+        for fr in mr.kv.frames():
+            yield from fr.pairs()
+    elif mr.kmv is not None:
+        for fr in mr.kmv.frames():
+            yield from fr.groups()
+
+
+def mr_dump(mr: MapReduce, fp):
+    for k, v in _iter_pairs(mr):
+        fp.write(f"{k} {v}\n")
