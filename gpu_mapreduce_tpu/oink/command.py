@@ -52,7 +52,7 @@ class Command:
     def message(self, msg: str):
         """Result message (reference error->message on rank 0)."""
         self.result_msg = msg
-        if self.screen is None:
+        if self.screen is None or self.screen is True:
             print(msg)
         elif self.screen is not False:
             self.screen.write(msg + "\n")

@@ -30,6 +30,40 @@ def _null(n: int) -> np.ndarray:
     return np.zeros(n, np.uint8)
 
 
+def host_kv(fr) -> KVFrame:
+    """Normalise a batch-map input to a host KVFrame (mesh backend hands
+    ShardedKV; the reference's analog is request_page's disk→mem read)."""
+    return fr if isinstance(fr, KVFrame) else fr.to_host()
+
+
+def host_kmv(fr):
+    """Normalise a batch-reduce input to a host KMVFrame."""
+    from ..core.frame import KMVFrame
+    return fr if isinstance(fr, KMVFrame) else fr.to_host()
+
+
+def kv_keys(fr) -> np.ndarray:
+    return np.asarray(host_kv(fr).key.to_host().data)
+
+
+def kv_values(fr) -> np.ndarray:
+    return np.asarray(host_kv(fr).value.to_host().data)
+
+
+def kmv_keys(fr) -> np.ndarray:
+    return np.asarray(host_kmv(fr).key.to_host().data)
+
+
+def kmv_values(fr) -> np.ndarray:
+    return np.asarray(host_kmv(fr).values.to_host().data)
+
+
+def seg_ids(fr) -> np.ndarray:
+    """Row → group-index map for a KMVFrame's flat value column."""
+    fr = host_kmv(fr)
+    return np.repeat(np.arange(len(fr)), np.asarray(fr.nvalues))
+
+
 def _parse_cols(filename: str, dtypes) -> list:
     """Whitespace table → one exact-dtype array per column (u64 vertex ids
     parse as integers, never through float — ids ≥ 2^53 stay exact)."""
@@ -60,6 +94,13 @@ def read_edge_label(itask, filename, kv, ptr):
     (map_read_edge_label.cpp)."""
     vi, vj, lab = _parse_cols(filename, (np.uint64, np.uint64, np.int64))
     kv.add_batch(np.stack([vi, vj], 1), lab)
+
+
+def read_vertex_value(itask, filename, kv, ptr):
+    """'v u' lines → key=v, value=u, both u64 (cc_stats input: Vi Zi
+    pairs, oink/cc_stats.cpp CCStats::read)."""
+    v, u = _parse_cols(filename, (np.uint64, np.uint64))
+    kv.add_batch(v, u)
 
 
 def read_vertex_weight(itask, filename, kv, ptr):

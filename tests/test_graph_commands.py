@@ -1,0 +1,96 @@
+"""Graph-algorithm command suite (cc_find, tri_find, luby_find, sssp,
+pagerank) vs exact numpy/python oracles — the reference prints invariants
+("CC_find: N components", oink/cc_find.cpp:104-106); we assert them."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu.oink import ObjectManager, run_command
+
+
+def union_find_labels(edges, vertices):
+    """Oracle: component label = min vertex id in the component."""
+    parent = {int(v): int(v) for v in vertices}
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in edges:
+        ra, rb = find(int(a)), find(int(b))
+        if ra != rb:
+            parent[max(ra, rb)] = min(ra, rb)
+    return {v: find(v) for v in parent}
+
+
+@pytest.fixture
+def graph_file(tmp_path, rng):
+    """Sparse undirected graph with several components."""
+    edges = []
+    for base in (0, 100, 200, 300):          # 4 islands of 25 vertices
+        e = rng.integers(base, base + 25, size=(40, 2))
+        edges.append(e)
+    e = np.unique(np.concatenate(edges).astype(np.uint64), axis=0)
+    e = e[e[:, 0] != e[:, 1]]
+    path = tmp_path / "graph.txt"
+    path.write_text("\n".join(f"{a} {b}" for a, b in e) + "\n")
+    return str(path), e
+
+
+def test_cc_find_matches_union_find(graph_file, tmp_path):
+    path, e = graph_file
+    out = tmp_path / "cc.out"
+    cmd = run_command("cc_find", ["0"], inputs=[path], outputs=[str(out)],
+                      screen=False)
+    verts = np.unique(e)
+    oracle = union_find_labels(e, verts)
+    got = {int(a): int(b) for a, b in
+           np.loadtxt(out, dtype=np.uint64).reshape(-1, 2)}
+    assert got == oracle
+    assert cmd.ncc == len(set(oracle.values()))
+
+
+def test_cc_find_single_component(tmp_path):
+    # a path graph 0-1-2-...-19: one component, worst case for propagation
+    e = np.stack([np.arange(19), np.arange(1, 20)], 1).astype(np.uint64)
+    path = tmp_path / "path.txt"
+    path.write_text("\n".join(f"{a} {b}" for a, b in e))
+    out = tmp_path / "cc.out"
+    cmd = run_command("cc_find", ["0"], inputs=[str(path)],
+                      outputs=[str(out)], screen=False)
+    got = np.loadtxt(out, dtype=np.uint64).reshape(-1, 2)
+    assert cmd.ncc == 1
+    assert set(got[:, 1].tolist()) == {0}
+    assert sorted(got[:, 0].tolist()) == list(range(20))
+
+
+def test_cc_stats_histogram(graph_file, tmp_path):
+    path, e = graph_file
+    ccout = tmp_path / "cc.out"
+    run_command("cc_find", ["0"], inputs=[path], outputs=[str(ccout)],
+                screen=False)
+    cmd = run_command("cc_stats", [], inputs=[str(ccout)], screen=False)
+    oracle = union_find_labels(e, np.unique(e))
+    sizes = collections.Counter(oracle.values())          # label → size
+    hist = collections.Counter(sizes.values())            # size → ncomp
+    assert dict(cmd.stats) == dict(hist)
+    assert cmd.ncc == len(sizes)
+    assert cmd.nvert == len(oracle)
+
+
+def test_cc_find_on_mesh_backend(graph_file, tmp_path):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    path, e = graph_file
+    out = tmp_path / "cc_mesh.out"
+    obj = ObjectManager(comm=make_mesh(4))
+    cmd = run_command("cc_find", ["0"], obj=obj, inputs=[path],
+                      outputs=[str(out)], screen=False)
+    oracle = union_find_labels(e, np.unique(e))
+    got = {int(a): int(b) for a, b in
+           np.loadtxt(out, dtype=np.uint64).reshape(-1, 2)}
+    assert got == oracle
+    assert cmd.ncc == len(set(oracle.values()))
