@@ -151,11 +151,13 @@ class CCFind(Command):
             tmp = obj.create_mr()
             tmp.map_mr(mrv, zone_tagged, batch=True)
             mrz.add(tmp)
+            obj.free_mr(tmp)
             mrz.collate()
             mrz.reduce(edge_zone, batch=True)
             mrz.collate()
             nchanged = mrz.reduce(zone_winner, batch=True)
             if not nchanged:
+                obj.free_mr(mrz)
                 break
             tmp = obj.create_mr()
             tmp.map_mr(mrv, invert_zone_tagged, batch=True)
@@ -164,6 +166,9 @@ class CCFind(Command):
             tmp.add(tmp2)
             tmp.collate()
             tmp.reduce(zone_reassign, batch=True)
+            obj.free_mr(mrz)
+            obj.free_mr(tmp2)
+            obj.free_mr(mrv)
             mrv = tmp
 
         mrt = obj.create_mr()

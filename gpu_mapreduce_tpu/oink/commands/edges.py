@@ -8,8 +8,9 @@ from __future__ import annotations
 
 from ...core.runtime import MRError
 from ..command import Command, command
-from ..kernels import (cull, edge_to_vertices, edge_upper, print_edge,
-                       print_vertex, read_edge, read_edge_weight)
+from ..kernels import (cull, edge_both_directions, edge_to_vertices,
+                       edge_upper, print_edge, print_vertex, read_edge,
+                       read_edge_weight)
 
 
 @command("edge_upper")
@@ -72,14 +73,7 @@ class Neighbor(Command):
         obj = self.obj
         mre = obj.input(1, read_edge)
         mrn = obj.create_mr()
-
-        def both_directions(fr, kv, ptr):
-            import numpy as np
-            e = np.asarray(fr.key.to_host().data)
-            kv.add_batch(np.concatenate([e[:, 0], e[:, 1]]),
-                         np.concatenate([e[:, 1], e[:, 0]]))
-
-        mrn.map_mr(mre, both_directions, batch=True)
+        mrn.map_mr(mre, edge_both_directions, batch=True)
         self.nvert = mrn.collate()
         obj.output(1, mrn, _print_neighbors)
         obj.cleanup()

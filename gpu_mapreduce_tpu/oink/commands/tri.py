@@ -1,0 +1,227 @@
+"""tri_find / neigh_tri — Cohen's MapReduce triangle enumeration.
+
+Reference: ``oink/tri_find.cpp:43-81`` (degree-augment edges, low-degree
+vertex emits angles, join angles with original edges) and
+``oink/neigh_tri.cpp:40-69`` (per-vertex neighbor+triangle files).
+
+All kernels are batch/vectorised: the O(d²) angle emission builds its pair
+index arrays with repeat/cumsum instead of nested loops, and the
+valuebytes-discriminated unions of the reference become tagged ``[tag,a,b]``
+u64 rows (tag 0 = original edge / plain neighbor, tag 1 = angle / triangle
+edge)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...core.runtime import MRError
+from ..command import Command, command
+from ..kernels import (_parse_cols, edge_both_directions, host_kmv, kmv_keys,
+                       kmv_values, kv_keys, kv_values, read_edge, seg_ids,
+                       sum_values)
+
+
+def first_degree(fr, kv, ptr):
+    """Per-vertex group (neighbors list, size d): emit canonical edge →
+    (d,0) or (0,d) depending on which endpoint the center is
+    (reduce_first_degree, oink/tri_find.cpp:116-159)."""
+    fr = host_kmv(fr)
+    nb = kmv_values(fr).astype(np.uint64)            # [n] neighbor ids
+    center = np.repeat(kmv_keys(fr).astype(np.uint64), fr.nvalues)
+    d = np.repeat(np.asarray(fr.nvalues).astype(np.uint64), fr.nvalues)
+    lo = np.minimum(center, nb)
+    hi = np.maximum(center, nb)
+    is_i = center < nb
+    zero = np.zeros(len(nb), np.uint64)
+    di = np.where(is_i, d, zero)
+    dj = np.where(is_i, zero, d)
+    kv.add_batch(np.stack([lo, hi], 1), np.stack([di, dj], 1))
+
+
+def low_degree(fr, kv, ptr):
+    """(Eij:(Di,Dj)) → lower-degree endpoint : other endpoint; degree tie
+    broken toward Vi (map_low_degree, oink/tri_find.cpp:185-207)."""
+    e = kv_keys(fr)
+    deg = kv_values(fr)
+    low_is_i = (deg[:, 0] < deg[:, 1]) | ((deg[:, 0] == deg[:, 1]) &
+                                          (e[:, 0] < e[:, 1]))
+    kv.add_batch(np.where(low_is_i, e[:, 0], e[:, 1]),
+                 np.where(low_is_i, e[:, 1], e[:, 0]))
+
+
+def nsq_angles(fr, kv, ptr):
+    """Per-center group: every unordered neighbor pair (Vj,Vk) is an "angle"
+    (a triangle missing the Vj-Vk edge): emit canonical (Vj,Vk) → [1,center,0]
+    (reduce_nsq_angles, oink/tri_find.cpp:211-276, the O(d²) kernel)."""
+    fr = host_kmv(fr)
+    nb = kmv_values(fr).astype(np.uint64)
+    n = len(nb)
+    seg = seg_ids(fr)
+    end = np.asarray(fr.offsets)[1:][seg]            # group end per row
+    rem = (end - np.arange(n) - 1).astype(np.int64)  # later rows in group
+    j_idx = np.repeat(np.arange(n), rem)
+    off = np.concatenate([[0], np.cumsum(rem)])
+    k_idx = np.arange(int(rem.sum())) - off[j_idx] + j_idx + 1
+    vj, vk = nb[j_idx], nb[k_idx]
+    center = kmv_keys(fr).astype(np.uint64)[seg[j_idx]]
+    lo = np.minimum(vj, vk)
+    hi = np.maximum(vj, vk)
+    one = np.ones(len(lo), np.uint64)
+    kv.add_batch(np.stack([lo, hi], 1),
+                 np.stack([one, center, np.zeros(len(lo), np.uint64)], 1))
+
+
+def edge_null_tagged(fr, kv, ptr):
+    """Eij:NULL → Eij:[0,0,0] — original-edge marker rows for the angle
+    join (the reference reuses valuebytes==0)."""
+    e = kv_keys(fr)
+    kv.add_batch(e, np.zeros((len(e), 3), np.uint64))
+
+
+def emit_triangles(fr, kv, ptr):
+    """Per-edge group of tagged rows: if an original-edge marker is present,
+    every angle row (center Vi) completes a triangle (Vi,Vj,Vk)
+    (reduce_emit_triangles, oink/tri_find.cpp:280-...)."""
+    fr = host_kmv(fr)
+    vals = kmv_values(fr)                            # [n,3] tagged
+    seg = seg_ids(fr)
+    is_edge = vals[:, 0] == 0
+    has_edge = np.zeros(len(fr), bool)
+    has_edge[seg[is_edge]] = True
+    take = (~is_edge) & has_edge[seg]
+    e = kmv_keys(fr).astype(np.uint64)[seg[take]]    # [m,2] the (Vj,Vk) edge
+    center = vals[take, 1]
+    kv.add_batch(np.stack([center, e[:, 0], e[:, 1]], 1),
+                 np.zeros(len(center), np.uint8))
+
+
+def print_tri(k, v, fp):
+    fp.write(f"{k[0]} {k[1]} {k[2]}\n")
+
+
+@command("tri_find")
+class TriFind(Command):
+    """tri_find: enumerate all triangles of an edge list; output one
+    (Vi,Vj,Vk) line per triangle, Vi = the low-degree "center" vertex that
+    emitted the angle (oink/tri_find.cpp:43-81)."""
+
+    ninputs = 1
+    noutputs = 1
+
+    def params(self, args):
+        if args:
+            raise MRError("Illegal tri_find command")
+
+    def run(self):
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+        mrt = obj.create_mr()
+
+        # augment edges with endpoint degrees: mrt = (Eij, (Di, Dj))
+        mrt.map_mr(mre, edge_both_directions, batch=True)
+        mrt.collate()
+        mrt.reduce(first_degree, batch=True)
+        mrt.collate()
+        mrt.reduce(sum_values, batch=True)
+
+        # angles from the low-degree endpoint, joined with original edges
+        mrt.map_mr(mrt, low_degree, batch=True)
+        mrt.collate()
+        mrt.reduce(nsq_angles, batch=True)
+        tmp = obj.create_mr()
+        tmp.map_mr(mre, edge_null_tagged, batch=True)
+        mrt.add(tmp)
+        mrt.collate()
+        ntri = mrt.reduce(emit_triangles, batch=True)
+
+        self.ntri = ntri
+        obj.output(1, mrt, print_tri)
+        self.message(f"Tri_find: {ntri} triangles")
+        obj.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# neigh_tri
+# ---------------------------------------------------------------------------
+
+def read_adjacency(itask, filename, kv, ptr):
+    """'vi vj vk ...' adjacency lines → (vi : [0,vj,0]) tagged neighbor rows
+    (NeighTri::nread, oink/neigh_tri.cpp:76-92)."""
+    rows_v, rows_n = [], []
+    with open(filename) as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            vi = int(toks[0])
+            for t in toks[1:]:
+                rows_v.append(vi)
+                rows_n.append(int(t))
+    v = np.asarray(rows_v, np.uint64)
+    nb = np.asarray(rows_n, np.uint64)
+    zero = np.zeros(len(v), np.uint64)
+    kv.add_batch(v, np.stack([zero, nb, zero], 1))
+
+
+def read_tri(itask, filename, kv, ptr):
+    """'vi vj vk' triangle lines → key [vi,vj,vk] : NULL
+    (NeighTri::tread, oink/neigh_tri.cpp:96-109)."""
+    vi, vj, vk = _parse_cols(filename, (np.uint64,) * 3)
+    kv.add_batch(np.stack([vi, vj, vk], 1), np.zeros(len(vi), np.uint8))
+
+
+def tri_to_vertex_edges(fr, kv, ptr):
+    """(Vi,Vj,Vk):NULL → each corner : [1, other1, other2] tagged
+    triangle-edge rows (NeighTri::map1, oink/neigh_tri.cpp:143-160)."""
+    t = kv_keys(fr)
+    one = np.ones(len(t), np.uint64)
+    kv.add_batch(
+        np.concatenate([t[:, 0], t[:, 1], t[:, 2]]),
+        np.concatenate([np.stack([one, t[:, 1], t[:, 2]], 1),
+                        np.stack([one, t[:, 0], t[:, 2]], 1),
+                        np.stack([one, t[:, 0], t[:, 1]], 1)]))
+
+
+@command("neigh_tri")
+class NeighTri(Command):
+    """neigh_tri dirname: per-vertex files dirname/<Vi> listing the vertex's
+    neighbors ("vi vj" lines) and its triangles' opposite edges ("vj vk"
+    lines) (oink/neigh_tri.cpp:40-69).  Inputs: 1 = adjacency file(s),
+    2 = triangle file(s) from tri_find."""
+
+    ninputs = 2
+    noutputs = 0  # output is the dirname arg, matching the reference
+
+    def params(self, args):
+        if len(args) != 1:
+            raise MRError("Illegal neigh_tri command")
+        self.dirname = args[0]
+
+    def run(self):
+        obj = self.obj
+        mrn = obj.input(1, read_adjacency)
+        mrt = obj.input(2, read_tri)
+        mrnplus = obj.copy_mr(mrn)
+        mrnplus.map_mr(mrt, tri_to_vertex_edges, batch=True, addflag=1)
+        mrnplus.collate()
+
+        os.makedirs(self.dirname, exist_ok=True)
+        nvert = [0]
+
+        def write_vertex(key, vals, ptr):
+            vi = int(key)
+            with open(os.path.join(self.dirname, str(vi)), "w") as fp:
+                for tag, a, b in vals:
+                    if int(tag) == 0:
+                        fp.write(f"{vi} {int(a)}\n")
+                    else:
+                        fp.write(f"{int(a)} {int(b)}\n")
+            nvert[0] += 1
+
+        mrnplus.scan_kmv(write_vertex)
+        self.nvert = nvert[0]
+        self.message(f"Neigh_tri: {self.nvert} vertex files in "
+                     f"{self.dirname}")
+        obj.cleanup()

@@ -141,6 +141,15 @@ def edge_to_vertex_pair(fr: KVFrame, kv, ptr):
     kv.add_batch(e[:, 0], e[:, 1])
 
 
+def edge_both_directions(fr, kv, ptr):
+    """Eij:NULL → Vi:Vj and Vj:Vi — the adjacency expansion shared by
+    neighbor (oink/neighbor.cpp:84-116) and tri_find's map_edge_vert
+    (oink/tri_find.cpp:104-112)."""
+    e = kv_keys(fr)
+    kv.add_batch(np.concatenate([e[:, 0], e[:, 1]]),
+                 np.concatenate([e[:, 1], e[:, 0]]))
+
+
 def edge_upper(fr: KVFrame, kv, ptr):
     """Canonicalise to Vi<Vj, drop self-loops (map_edge_upper.cpp:15-24)."""
     e = np.asarray(fr.key.to_host().data)

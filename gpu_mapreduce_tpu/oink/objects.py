@@ -84,6 +84,18 @@ class ObjectManager:
             raise MRError(f"no MapReduce object named {name!r}")
         return self.named[name]
 
+    def free_mr(self, mr: MapReduce):
+        """Free a temporary's data mid-command (iterative commands create
+        MRs per round; deferring to cleanup() would grow memory linearly
+        with iteration count)."""
+        if mr.kv is not None:
+            mr.kv.free()
+            mr.kv = None
+        if mr.kmv is not None:
+            mr.kmv.free()
+            mr.kmv = None
+        self._temps = [m for m in self._temps if m is not mr]
+
     def delete_mr(self, name: str):
         mr = self.named.pop(name, None)
         if mr is not None:

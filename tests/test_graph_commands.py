@@ -94,3 +94,100 @@ def test_cc_find_on_mesh_backend(graph_file, tmp_path):
            np.loadtxt(out, dtype=np.uint64).reshape(-1, 2)}
     assert got == oracle
     assert cmd.ncc == len(set(oracle.values()))
+
+
+# ---------------------------------------------------------------------------
+# tri_find / neigh_tri
+# ---------------------------------------------------------------------------
+
+def brute_triangles(edges):
+    """Oracle: set of frozenset vertex triples forming triangles."""
+    es = {(int(a), int(b)) for a, b in edges}
+    adj = collections.defaultdict(set)
+    for a, b in es:
+        adj[a].add(b)
+        adj[b].add(a)
+    tris = set()
+    for a, b in es:
+        for c in adj[a] & adj[b]:
+            tris.add(frozenset((a, b, c)))
+    return tris
+
+
+@pytest.fixture
+def tri_file(tmp_path, rng):
+    """Canonical (upper, deduped) edge file — what tri_find expects
+    (examples/in.tri runs edge_upper first)."""
+    e = rng.integers(0, 18, size=(120, 2)).astype(np.uint64)
+    e = e[e[:, 0] != e[:, 1]]
+    e = np.unique(np.stack([np.minimum(e[:, 0], e[:, 1]),
+                            np.maximum(e[:, 0], e[:, 1])], 1), axis=0)
+    path = tmp_path / "upper.txt"
+    path.write_text("\n".join(f"{a} {b}" for a, b in e) + "\n")
+    return str(path), e
+
+
+def test_tri_find_matches_brute_force(tri_file, tmp_path):
+    path, e = tri_file
+    out = tmp_path / "tri.out"
+    cmd = run_command("tri_find", [], inputs=[path], outputs=[str(out)],
+                      screen=False)
+    oracle = brute_triangles(e)
+    got_rows = np.loadtxt(out, dtype=np.uint64).reshape(-1, 3)
+    got = {frozenset(map(int, row)) for row in got_rows}
+    assert got == oracle
+    assert cmd.ntri == len(oracle) == len(got_rows)  # each exactly once
+
+
+def test_tri_find_triangle_free(tmp_path):
+    # bipartite graph has no triangles
+    e = np.array([(a, b) for a in range(5) for b in range(10, 15)],
+                 dtype=np.uint64)
+    path = tmp_path / "bip.txt"
+    path.write_text("\n".join(f"{a} {b}" for a, b in e))
+    cmd = run_command("tri_find", [], inputs=[str(path)], screen=False)
+    assert cmd.ntri == 0
+
+
+def test_tri_find_on_mesh_backend(tri_file, tmp_path):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    path, e = tri_file
+    out = tmp_path / "tri_mesh.out"
+    obj = ObjectManager(comm=make_mesh(4))
+    cmd = run_command("tri_find", [], obj=obj, inputs=[path],
+                      outputs=[str(out)], screen=False)
+    oracle = brute_triangles(e)
+    got = {frozenset(map(int, row))
+           for row in np.loadtxt(out, dtype=np.uint64).reshape(-1, 3)}
+    assert got == oracle and cmd.ntri == len(oracle)
+
+
+def test_neigh_tri_per_vertex_files(tri_file, tmp_path):
+    path, e = tri_file
+    # adjacency file from the neighbor command, triangles from tri_find
+    adjf, trif = tmp_path / "adj.out", tmp_path / "tri.out"
+    run_command("neighbor", [], inputs=[path], outputs=[str(adjf)],
+                screen=False)
+    run_command("tri_find", [], inputs=[path], outputs=[str(trif)],
+                screen=False)
+    outdir = tmp_path / "nt"
+    cmd = run_command("neigh_tri", [str(outdir)],
+                      inputs=[str(adjf), str(trif)], screen=False)
+    adj = collections.defaultdict(set)
+    for a, b in e.tolist():
+        adj[a].add(b)
+        adj[b].add(a)
+    tris = brute_triangles(e)
+    verts = sorted(adj)
+    assert cmd.nvert == len(verts)
+    for v in verts:
+        lines = (outdir / str(v)).read_text().splitlines()
+        # neighbor lines "v x" must cover adj[v]; triangle lines "a b" are
+        # the opposite edge of each triangle containing v
+        pairs = [tuple(map(int, l.split())) for l in lines]
+        nb_lines = [p for p in pairs if p[0] == v and p[1] in adj[v]]
+        tri_lines = [p for p in pairs if frozenset((v,) + p) in tris]
+        assert len(nb_lines) + len(tri_lines) == len(pairs)
+        assert {p[1] for p in nb_lines} == adj[v]
+        want_tris = {t for t in tris if v in t}
+        assert {frozenset((v,) + p) for p in tri_lines} == want_tris
