@@ -1,0 +1,189 @@
+"""luby_find — Luby maximal independent set.
+
+Reference: ``oink/luby_find.cpp:53-95`` (run loop) and its four reduce
+callbacks (``reduce_edge_winner`` 140, ``reduce_vert_winner`` 186,
+``reduce_vert_loser`` 238, ``reduce_vert_emit`` 289).
+
+Round semantics (identical to the reference composition):
+
+1. **edge_winner** — an edge is alive iff no endpoint was flagged last
+   round; alive edge picks its winner = endpoint with smaller (rand, id)
+   and emits ``(v : [other, won])`` both directions;
+2. **vert_winner** — a vertex that wins *all* its alive edges is a
+   round-winner; it tells every neighbour so;
+3. **vert_loser** — a vertex with a round-winner neighbour is a loser; it
+   tells every neighbour so;
+4. **vert_emit** — a vertex whose neighbours are *all* losers joins the
+   MIS (this covers round-winners and vertices isolated by removals) and
+   the edge list for the next round is rebuilt with dead-markers on any
+   edge touching a loser.  Loop until edge_winner emits nothing.
+
+Two TPU-first redesigns vs the reference:
+
+* the reference assigns each vertex a random via ``srand48(v+seed)`` and
+  *carries* it through every shuffle in ERAND/VRAND/VFLAG structs,
+  discriminating record kinds by ``valuebytes``; our vertex random is a
+  pure splitmix64 function of (v, seed) recomputed where needed, so every
+  value is one fixed-width ``[other, tag]`` u64 row — no variable-size
+  struct zoo, and the shuffles move half the bytes;
+* each reduce is one vectorised segment pass (``np.maximum.reduceat``
+  over group offsets) instead of a per-group callback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.runtime import MRError
+from ..command import Command, command
+from ..kernels import (group_any, host_kmv, kmv_keys, kmv_values, kv_keys,
+                       print_vertex, read_edge, seg_ids)
+
+_U = np.uint64
+
+
+def vertex_rand(v: np.ndarray, seed: int) -> np.ndarray:
+    """Deterministic per-vertex random in [0,1): splitmix64(v+seed) →
+    top-53-bit float (the reference's srand48(v+seed)/drand48,
+    oink/luby_find.cpp:130-134 — consistent across every use of v)."""
+    x = v.astype(np.uint64) + _U(seed & 0xFFFFFFFFFFFFFFFF)
+    with np.errstate(over="ignore"):
+        x = (x + _U(0x9E3779B97F4A7C15))
+        z = x
+        z = (z ^ (z >> _U(30))) * _U(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> _U(27))) * _U(0x94D049BB133111EB)
+        z = z ^ (z >> _U(31))
+    return (z >> _U(11)).astype(np.float64) / float(1 << 53)
+
+
+# ---------------------------------------------------------------------------
+# round kernels (batch reduces)
+# ---------------------------------------------------------------------------
+
+def edge_winner(fr, kv, ptr):
+    """KMV edge:[flags] → (v : [other, key-won]) per alive edge, both
+    directions (reduce_edge_winner, oink/luby_find.cpp:140-182)."""
+    fr = host_kmv(fr)
+    if len(fr) == 0:
+        return
+    e = kmv_keys(fr)                        # [g, 2]
+    vals = kmv_values(fr)                   # [n] u8 NULL (round 1) / u64 tag
+    dead = group_any(vals != 0, fr)
+    e = e[~dead]
+    if len(e) == 0:
+        return
+    seed = ptr
+    ri, rj = vertex_rand(e[:, 0], seed), vertex_rand(e[:, 1], seed)
+    vi_wins = (ri < rj) | ((ri == rj) & (e[:, 0] < e[:, 1]))
+    w = np.where(vi_wins, e[:, 0], e[:, 1])
+    l = np.where(vi_wins, e[:, 1], e[:, 0])
+    one = np.ones(len(e), _U)
+    kv.add_batch(np.concatenate([w, l]),
+                 np.concatenate([np.stack([l, one], 1),
+                                 np.stack([w, one - 1], 1)]))
+
+
+def vert_winner(fr, kv, ptr):
+    """Group per v of [other, won]: v wins all edges ⇒ round-winner; emit
+    (other : [v, v-is-round-winner]) (reduce_vert_winner)."""
+    fr = host_kmv(fr)
+    if len(fr) == 0:
+        return
+    vals = kmv_values(fr)                   # [n, 2]
+    seg = seg_ids(fr)
+    lost_any = group_any(vals[:, 1] == 0, fr)
+    tag = (~lost_any[seg]).astype(_U)
+    kv.add_batch(vals[:, 0], np.stack([kmv_keys(fr)[seg], tag], 1))
+
+
+def vert_loser(fr, kv, ptr):
+    """Group per v of [other, other-is-round-winner]: any winner neighbour
+    ⇒ v is a loser; emit (other : [v, v-is-loser]) (reduce_vert_loser)."""
+    fr = host_kmv(fr)
+    if len(fr) == 0:
+        return
+    vals = kmv_values(fr)
+    seg = seg_ids(fr)
+    loser = group_any(vals[:, 1] == 1, fr)
+    tag = loser[seg].astype(_U)
+    kv.add_batch(vals[:, 0], np.stack([kmv_keys(fr)[seg], tag], 1))
+
+
+def vert_emit(fr, kv, ptr):
+    """Group per v of [other, other-is-loser]: all neighbours losers ⇒ v
+    joins the MIS (into the open accumulator MR via ptr); rebuild next
+    round's edges with the loser tag as dead-marker
+    (reduce_vert_emit, oink/luby_find.cpp:289-344)."""
+    mrv = ptr
+    fr = host_kmv(fr)
+    if len(fr) == 0:
+        return
+    vals = kmv_values(fr)
+    seg = seg_ids(fr)
+    vkeys = kmv_keys(fr)
+    survivor_nb = group_any(vals[:, 1] == 0, fr)
+    mis = vkeys[~survivor_nb]
+    if len(mis):
+        mrv.kv.add_batch(mis, np.zeros(len(mis), np.uint8))
+    v, u = vkeys[seg], vals[:, 0]
+    edges = np.stack([np.minimum(v, u), np.maximum(v, u)], 1)
+    kv.add_batch(edges, vals[:, 1])
+
+
+def copy_edge(fr, kv, ptr):
+    """Eij:NULL → Eij:NULL working copy, self-loops dropped — a self-loop
+    vertex can never win its own edge and would cycle forever (the
+    reference's map_vert_random carries them into the same livelock;
+    we guard like edge_upper does)."""
+    e = kv_keys(fr)
+    e = e[e[:, 0] != e[:, 1]]
+    kv.add_batch(e, np.zeros(len(e), np.uint8))
+
+
+# ---------------------------------------------------------------------------
+# command
+# ---------------------------------------------------------------------------
+
+@command("luby_find")
+class LubyFind(Command):
+    """luby_find seed: maximal independent set of an undirected edge list;
+    output is one MIS vertex per line (oink/luby_find.cpp:53-115)."""
+
+    ninputs = 1
+    noutputs = 1
+
+    def params(self, args):
+        if len(args) != 1:
+            raise MRError("Illegal luby_find command")
+        self.seed = int(args[0])
+
+    def run(self):
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+        mrv = obj.create_mr()
+        mrw = obj.create_mr()
+
+        mrw.map_mr(mre, copy_edge, batch=True)
+        mrw.clone()
+
+        niterate = 0
+        mrv.open()
+        while True:
+            n = mrw.reduce(edge_winner, ptr=self.seed, batch=True)
+            if n == 0:
+                break
+            mrw.collate()
+            mrw.reduce(vert_winner, batch=True)
+            mrw.collate()
+            mrw.reduce(vert_loser, batch=True)
+            mrw.collate()
+            mrw.reduce(vert_emit, ptr=mrv, batch=True)
+            mrw.collate()
+            niterate += 1
+        nset = mrv.close()
+
+        self.nset, self.niterate = nset, niterate
+        obj.output(1, mrv, print_vertex)
+        self.message(f"Luby_find: {nset} MIS vertices in {niterate} "
+                     f"iterations")
+        obj.cleanup()

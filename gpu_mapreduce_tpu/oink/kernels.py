@@ -64,6 +64,14 @@ def seg_ids(fr) -> np.ndarray:
     return np.repeat(np.arange(len(fr)), np.asarray(fr.nvalues))
 
 
+def group_any(cond: np.ndarray, fr) -> np.ndarray:
+    """Per-group OR over a KMV frame's flat value rows — the shared segment
+    primitive behind luby's winner/loser votes, tri_find's has-edge test,
+    and cc_find's zone joins."""
+    offs = np.asarray(host_kmv(fr).offsets)[:-1]
+    return np.maximum.reduceat(cond.astype(np.uint8), offs).astype(bool)
+
+
 def _parse_cols(filename: str, dtypes) -> list:
     """Whitespace table → one exact-dtype array per column (u64 vertex ids
     parse as integers, never through float — ids ≥ 2^53 stay exact)."""

@@ -162,6 +162,78 @@ def test_tri_find_on_mesh_backend(tri_file, tmp_path):
     assert got == oracle and cmd.ntri == len(oracle)
 
 
+# ---------------------------------------------------------------------------
+# luby_find
+# ---------------------------------------------------------------------------
+
+def greedy_mis(edges, seed):
+    """Oracle: Luby with fixed per-vertex randoms equals sequential greedy
+    MIS over vertices ordered by (rand, id)."""
+    from gpu_mapreduce_tpu.oink.commands.luby import vertex_rand
+    adj = collections.defaultdict(set)
+    for a, b in edges.tolist():
+        if a != b:
+            adj[a].add(b)
+            adj[b].add(a)
+    verts = np.array(sorted(adj), dtype=np.uint64)
+    order = sorted(verts.tolist(),
+                   key=lambda v: (float(vertex_rand(np.array([v],
+                                   dtype=np.uint64), seed)[0]), v))
+    mis = set()
+    for v in order:
+        if not (adj[v] & mis):
+            mis.add(v)
+    return mis, adj
+
+
+@pytest.mark.parametrize("seed", [42, 7])
+def test_luby_find_is_maximal_independent(graph_file, tmp_path, seed):
+    path, e = graph_file
+    out = tmp_path / "mis.out"
+    cmd = run_command("luby_find", [str(seed)], inputs=[path],
+                      outputs=[str(out)], screen=False)
+    oracle, adj = greedy_mis(e, seed)
+    got = set(np.loadtxt(out, dtype=np.uint64).reshape(-1).tolist())
+    # independence + maximality against the input graph
+    for v in got:
+        assert not (adj[v] & got)
+    for v in adj:
+        assert v in got or (adj[v] & got)
+    # determinism: parallel rounds == sequential greedy by (rand, id)
+    assert got == oracle
+    assert cmd.nset == len(got)
+
+
+def test_luby_find_complete_graph(tmp_path):
+    # K6: MIS is exactly one vertex, one round
+    e = np.array([(a, b) for a in range(6) for b in range(a + 1, 6)],
+                 dtype=np.uint64)
+    path = tmp_path / "k6.txt"
+    path.write_text("\n".join(f"{a} {b}" for a, b in e))
+    cmd = run_command("luby_find", ["1"], inputs=[str(path)], screen=False)
+    assert cmd.nset == 1
+
+
+def test_luby_find_self_loop_terminates(tmp_path):
+    # a self-loop must not livelock the round loop
+    path = tmp_path / "loop.txt"
+    path.write_text("1 2\n5 5\n2 3\n")
+    cmd = run_command("luby_find", ["3"], inputs=[str(path)], screen=False)
+    assert cmd.nset >= 1
+
+
+def test_luby_find_on_mesh_backend(graph_file, tmp_path):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    path, e = graph_file
+    out = tmp_path / "mis_mesh.out"
+    obj = ObjectManager(comm=make_mesh(4))
+    run_command("luby_find", ["42"], obj=obj, inputs=[path],
+                outputs=[str(out)], screen=False)
+    oracle, _ = greedy_mis(e, 42)
+    got = set(np.loadtxt(out, dtype=np.uint64).reshape(-1).tolist())
+    assert got == oracle
+
+
 def test_neigh_tri_per_vertex_files(tri_file, tmp_path):
     path, e = tri_file
     # adjacency file from the neighbor command, triangles from tri_find
