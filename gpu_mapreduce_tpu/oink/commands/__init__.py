@@ -1,4 +1,5 @@
 """Command plugin modules — importing registers each with the
 COMMANDS registry (the generated style_command.h of the reference)."""
 
-from . import cc, degree, edges, histo, luby, rmat, tri, wordfreq  # noqa: F401
+from . import (cc, degree, edges, histo, luby, pagerank, rmat,  # noqa: F401
+               sssp, tri, wordfreq)

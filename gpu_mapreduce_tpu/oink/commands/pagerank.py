@@ -1,0 +1,94 @@
+"""pagerank — damped PageRank over a directed edge list.
+
+The reference names this command but ships an empty iteration body
+(``oink/pagerank.cpp:53-56``, SURVEY.md §2.5) — it reads weighted edges,
+builds the vertex list, loops ``maxiter`` times doing nothing, and prints
+the *edges*.  This implementation supplies the real algorithm from the
+composition pattern, backed by the flagship TPU model
+(:mod:`gpu_mapreduce_tpu.models.pagerank`): dense ranks, on-device
+``lax.while_loop`` convergence, mesh-sharded edges + one ICI psum per
+iteration when the ObjectManager carries a mesh.
+
+Script syntax (reference ``PageRank::params``): ``pagerank tol maxiter
+alpha``.  Edge weights are accepted in the input ('vi vj [wt]') for
+script parity but rank follows link structure only (classic PageRank).
+Output: 'v rank' per vertex; self.ranks = {v: rank}.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.runtime import MRError
+from ..command import Command, command
+from ..kernels import kv_keys, read_edge, read_edge_weight
+from ...models.pagerank import pagerank, pagerank_sharded
+
+
+def _read_edges_sniff(itask, filename, kv, ptr):
+    """'vi vj' or 'vi vj wt' lines → key=[vi,vj], value=NULL — the command
+    accepts both the reference's weighted input and plain edge lists."""
+    first = []
+    with open(filename, "rb") as f:
+        for line in f:
+            first = line.split()
+            if first:
+                break
+    if len(first) == 3:
+        read_edge_weight(itask, filename, kv, ptr)
+    else:
+        read_edge(itask, filename, kv, ptr)
+
+
+@command("pagerank")
+class PageRankCommand(Command):
+    """pagerank tol maxiter alpha (oink/pagerank.cpp:67-75)."""
+
+    ninputs = 1
+    noutputs = 1
+
+    def params(self, args):
+        if len(args) != 3:
+            raise MRError("Illegal pagerank command")
+        self.tolerance = float(args[0])
+        self.maxiter = int(args[1])
+        self.alpha = float(args[2])
+
+    def run(self):
+        obj = self.obj
+        mre = obj.input(1, _read_edges_sniff)
+
+        edges: list = []
+        mre.scan_kv(lambda fr, p: edges.append(kv_keys(fr)), batch=True)
+        e = (np.concatenate(edges) if edges
+             else np.zeros((0, 2), np.uint64))
+        # compact arbitrary u64 ids to dense 0..n-1 for the dense-rank model
+        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+        n = len(verts)
+        if n == 0:
+            raise MRError("pagerank: empty edge list")
+        src, dst = inv.reshape(-1, 2)[:, 0], inv.reshape(-1, 2)[:, 1]
+
+        from jax.sharding import Mesh
+        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        if mesh is not None:
+            ranks, iters = pagerank_sharded(
+                mesh, src, dst, n, tol=self.tolerance,
+                maxiter=self.maxiter, damping=self.alpha)
+        else:
+            ranks, iters = pagerank(src, dst, n, tol=self.tolerance,
+                                    maxiter=self.maxiter,
+                                    damping=self.alpha)
+            ranks, iters = np.asarray(ranks), int(iters)
+
+        self.ranks = {int(v): float(r) for v, r in zip(verts, ranks)}
+        self.niterate = iters
+        self.nvert = n
+
+        mrr = obj.create_mr()
+        mrr.map(1, lambda i, kv, p: kv.add_batch(
+            verts, ranks.astype(np.float64)))
+        obj.output(1, mrr, lambda k, v, fp: fp.write(f"{k} {v:.8g}\n"))
+        self.message(f"PageRank: {n} vertices, {len(src)} edges, "
+                     f"{iters} iterations")
+        obj.cleanup()

@@ -1,0 +1,258 @@
+"""sssp — single-source shortest paths by MapReduce Bellman-Ford relaxation.
+
+Reference: ``oink/sssp.cpp:49-180`` (per-source BFS loop) with callbacks
+``reorganize_edges`` 187, ``add_source`` 205, ``pick_shortest_distances``
+244, ``update_adjacent_distances`` 299, and the DISTANCE/EDGEVALUE structs
+of ``oink/sssp.h`` (pred vertex, f32 weight, current flag).
+
+Iteration (identical to the reference composition): candidate distances in
+``mrpath`` are shuffled to their vertex, merged into the per-vertex state
+``mrvert``; ``pick_shortest`` keeps the best (distance, pred) per vertex
+and re-emits changed vertices; changed distances join the pre-aggregated
+adjacency ``mredge`` and ``update_adjacent`` relaxes each out-edge into
+the next round's candidates.  Converges when no vertex distance changes.
+
+TPU-first redesigns vs the reference:
+
+* the reference discriminates edge-vs-distance values by ``valuebytes``
+  (``sssp.cpp:318,341``); we keep one fixed-width lane: every value is a
+  ``[tag, a, b, c]`` f64 row — edge ``[0, vj, wt, 0]``, distance
+  ``[1, pred, dist, current]``.  Vertex ids stay exact through f64 up to
+  2^53 (RMAT-26 ids are < 2^27);
+* both relaxation reduces are single vectorised segment passes
+  (lexsort + reduceat), not per-group callbacks;
+* source selection: the reference seeds srand48 but actually takes the
+  first ``ncnt`` keys in arbitrary shuffle order (``sssp.cpp:363-375``);
+  we order vertices by (splitmix64(v+seed), v) — random *and*
+  deterministic across runs/backends;
+* output: the reference prints ``mrpath`` after convergence, which is
+  empty by construction (the loop exits only when pick_shortest emitted
+  nothing); we print the converged ``mrvert`` state — one
+  ``v dist pred`` line per vertex, inf for unreachable (DISTANCE's
+  FLT_MAX default, oink/sssp.h:52);
+* no-predecessor sentinel: the reference memsets pred to vertex id 0
+  (``sssp.h:51``, ``sssp.cpp:384``) and then skips relaxing edges back
+  to the predecessor — silently wrong when a real vertex 0 is adjacent
+  to the source.  We use -1.0 internally (no u64 vertex maps to it) and
+  print 0 for it, keeping the reference's output convention without the
+  miss.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.runtime import MRError
+from ..command import Command, command
+from ..kernels import (cull, edge_to_vertices, group_min_rows, host_kmv,
+                       kmv_keys, kmv_values, kv_keys, kv_values,
+                       read_edge_weight, seg_ids)
+from .luby import vertex_rand
+
+TAG_EDGE, TAG_DIST = 0.0, 1.0
+NO_PRED = -1.0                   # see module docstring: sentinel, not id 0
+
+
+# ---------------------------------------------------------------------------
+# batch kernels
+# ---------------------------------------------------------------------------
+
+def reorganize_edges(fr, kv, ptr):
+    """Eij:wt → vi:[0, vj, wt, 0] (reference reorganize_edges,
+    oink/sssp.cpp:187-199 — directed out-edges keyed by source)."""
+    e = kv_keys(fr)
+    wt = kv_values(fr).astype(np.float64)
+    rows = np.stack([np.full(len(e), TAG_EDGE),
+                     e[:, 1].astype(np.float64), wt,
+                     np.zeros(len(e))], 1)
+    kv.add_batch(e[:, 0], rows)
+
+
+def init_distance(fr, kv, ptr):
+    """v:* → v:[1, NO_PRED, inf, 1] (initialize_vertex_distances,
+    oink/sssp.cpp:231-237; DISTANCE() default wt=FLT_MAX, pred sentinel
+    corrected per module docstring)."""
+    k = kv_keys(fr)
+    rows = np.tile(np.array([TAG_DIST, NO_PRED, np.inf, 1.0]), (len(k), 1))
+    kv.add_batch(k, rows)
+
+
+def pick_shortest(fr, kv, ptr):
+    """Per-vertex group of distance rows: keep min (dist, pred); emit the
+    winner (current=1) back to the vertex state, and into the open
+    candidate MR iff it differs from the previous current row
+    (pick_shortest_distances, oink/sssp.cpp:244-293)."""
+    mrpath = ptr
+    fr = host_kmv(fr)
+    if len(fr) == 0:
+        return
+    vals = kmv_values(fr)                   # [n, 4] all TAG_DIST
+    seg = seg_ids(fr)
+    dist, pred, cur = vals[:, 2], vals[:, 1], vals[:, 3]
+
+    # winner per group = lexicographic min (dist, pred); every group has
+    # rows, so the present-groups array is exactly arange(len(fr))
+    _, win = group_min_rows(seg, dist, pred)
+
+    # previous current row per group (exactly one: init_distance seeds one
+    # and every round re-emits one; duplicates from the path merge are
+    # byte-identical so any is fine)
+    cur_rows = np.flatnonzero(cur == 1.0)
+    prev = np.full(len(fr), -1)
+    prev[seg[cur_rows]] = cur_rows
+
+    keys = kmv_keys(fr)
+    out = np.stack([np.full(len(fr), TAG_DIST), pred[win], dist[win],
+                    np.ones(len(fr))], 1)
+    kv.add_batch(keys, out)
+
+    changed = (dist[win] != dist[prev]) | (pred[win] != pred[prev])
+    changed |= prev < 0
+    if np.any(changed):
+        mrpath.kv.add_batch(keys[changed], out[changed])
+
+
+def update_adjacent(fr, kv, ptr):
+    """Per-vertex group of edge rows + changed-distance rows: re-emit the
+    adjacency; if a distance arrived, relax every out-edge into the open
+    candidate MR — skipping the predecessor and self-loops
+    (update_adjacent_distances, oink/sssp.cpp:299-360)."""
+    mrpath = ptr
+    fr = host_kmv(fr)
+    if len(fr) == 0:
+        return
+    vals = kmv_values(fr)                   # [n, 4] mixed tags
+    seg = seg_ids(fr)
+    keys = kmv_keys(fr)
+    is_dist = vals[:, 0] == TAG_DIST
+    is_edge = ~is_dist
+
+    # re-emit adjacency rows
+    kv.add_batch(keys[seg[is_edge]], vals[is_edge])
+
+    if not np.any(is_dist):
+        return
+    # best arriving distance per group
+    dseg, ddist, dpred = seg[is_dist], vals[is_dist, 2], vals[is_dist, 1]
+    groups, rows = group_min_rows(dseg, ddist, dpred)
+    best_dist = np.full(len(fr), np.inf)
+    best_pred = np.zeros(len(fr))
+    best_dist[groups] = ddist[rows]
+    best_pred[groups] = dpred[rows]
+    has_dist = np.zeros(len(fr), bool)
+    has_dist[dseg] = True
+
+    eseg = seg[is_edge]
+    vj = vals[is_edge, 1]
+    wt = vals[is_edge, 2]
+    vi = keys[seg[is_edge]].astype(np.float64)
+    relax = (has_dist[eseg] & (vj != best_pred[eseg]) & (vj != vi)
+             & np.isfinite(best_dist[eseg]))
+    if np.any(relax):
+        nk = vj[relax].astype(np.uint64)
+        rows = np.stack([np.full(len(nk), TAG_DIST), vi[relax],
+                         best_dist[eseg][relax] + wt[relax],
+                         np.zeros(len(nk))], 1)
+        mrpath.kv.add_batch(nk, rows)
+
+
+# ---------------------------------------------------------------------------
+# command
+# ---------------------------------------------------------------------------
+
+@command("sssp")
+class SSSPCommand(Command):
+    """sssp ncnt seed: shortest paths from ncnt deterministic-random
+    sources over a directed weighted edge list (oink/sssp.cpp).  Output
+    per source: 'v dist pred' lines (path suffixed .<i> when ncnt > 1);
+    self.results[source] = {v: (dist, pred)}."""
+
+    ninputs = 1
+    noutputs = 1
+
+    def params(self, args):
+        if len(args) != 2:
+            raise MRError("Illegal sssp command")
+        self.ncnt = int(args[0])
+        self.seed = int(args[1])
+
+    def run(self):
+        obj = self.obj
+        mredge = obj.input(1, read_edge_weight)
+
+        # vertex universe (no singletons, pre-aggregated — sssp.cpp:63-66)
+        mrvert = obj.create_mr()
+        mrvert.map_mr(mredge, edge_to_vertices, batch=True)
+        mrvert.collate()
+        mrvert.reduce(cull, batch=True)
+
+        # deterministic-random source list (see module docstring)
+        vcols: list = []
+        mrvert.scan_kv(lambda fr, p: vcols.append(kv_keys(fr)), batch=True)
+        varr = np.unique(np.concatenate(vcols).astype(np.uint64))
+        order = np.lexsort((varr, vertex_rand(varr, self.seed)))
+        sources = varr[order][:self.ncnt].tolist()
+
+        # adjacency keyed by source vertex, pre-aggregated (sssp.cpp:75-76)
+        mradj = obj.create_mr()
+        mradj.map_mr(mredge, reorganize_edges, batch=True)
+        mradj.aggregate()
+
+        self.results = {}
+        self.niters = {}
+        outd = obj.outputs[0] if obj.outputs else None
+        for cnt, source in enumerate(sources):
+            mrvert.map_mr(mrvert, init_distance, batch=True)
+            mredge_w = obj.create_mr()
+            mredge_w.add(mradj)
+
+            mrpath = obj.create_mr()
+            src_row = np.array([[TAG_DIST, NO_PRED, 0.0, 0.0]])
+            mrpath.map(1, lambda i, kv, p: kv.add_batch(
+                np.array([source], np.uint64), src_row))
+
+            niter = 0
+            while True:
+                mrpath.aggregate()
+                mrvert.add(mrpath)
+                obj.free_mr(mrpath)
+                mrpath = obj.create_mr()
+                mrpath.open()
+                mrvert.compress(pick_shortest, ptr=mrpath, batch=True)
+                nchanged = mrpath.close()
+                niter += 1
+                if nchanged == 0:
+                    break
+                mredge_w.add(mrpath)
+                obj.free_mr(mrpath)
+                mrpath = obj.create_mr()
+                mrpath.open()
+                mredge_w.compress(update_adjacent, ptr=mrpath, batch=True)
+                mrpath.close()
+            obj.free_mr(mrpath)
+            obj.free_mr(mredge_w)
+
+            cols: list = []
+            mrvert.scan_kv(lambda fr, p: cols.append(
+                (kv_keys(fr), kv_values(fr))), batch=True)
+            res = {}
+            for ks, vs in cols:
+                res.update(zip(
+                    ks.astype(np.uint64).tolist(),
+                    zip(vs[:, 2].tolist(),
+                        np.maximum(vs[:, 1], 0).astype(np.int64).tolist())))
+            self.results[source] = res
+            self.niters[source] = niter
+            nlabeled = sum(1 for d, _ in res.values() if np.isfinite(d))
+            self.message(f"SSSP: source {source}: {niter} iterations, "
+                         f"{nlabeled} vertices labeled")
+            if outd is not None and outd.path is not None:
+                path = (f"{outd.path}.{cnt}" if self.ncnt > 1
+                        else outd.path)
+                with open(path, "w") as fp:
+                    for v in sorted(res):
+                        d, p = res[v]
+                        fp.write(f"{v} {d:g} {p}\n")
+        if outd is not None and outd.mr_name is not None:
+            obj.name_mr(outd.mr_name, mrvert)
+        obj.cleanup()

@@ -64,6 +64,20 @@ def seg_ids(fr) -> np.ndarray:
     return np.repeat(np.arange(len(fr)), np.asarray(fr.nvalues))
 
 
+def group_min_rows(seg: np.ndarray, *keys: np.ndarray):
+    """Per-group lexicographic argmin: for rows labelled by ``seg``
+    (ascending group ids), return ``(groups, rows)`` — each present group
+    and the index of its minimal row by ``keys[0]``, ties broken by
+    ``keys[1]``, ...  One idiom for every 'best row per group' reduce
+    (sssp's pick_shortest/update_adjacent) so tie-breaking can never
+    diverge between call sites."""
+    order = np.lexsort(tuple(reversed(keys)) + (seg,))
+    gseg = seg[order]
+    first = np.ones(len(gseg), bool)
+    first[1:] = gseg[1:] != gseg[:-1]
+    return gseg[first], order[first]
+
+
 def group_any(cond: np.ndarray, fr) -> np.ndarray:
     """Per-group OR over a KMV frame's flat value rows — the shared segment
     primitive behind luby's winner/loser votes, tri_find's has-edge test,

@@ -19,6 +19,7 @@ commands create, consume, and hand back to the script layer.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
@@ -121,8 +122,11 @@ class ObjectManager:
         self.outputs = []
 
     # -- descriptors -------------------------------------------------------
-    def add_input(self, source: Union[str, Sequence[str], MapReduce]):
+    def add_input(self, source: Union[str, "os.PathLike",
+                                      Sequence[str], MapReduce]):
         """Add the next -i descriptor: path(s) or a named MR (by name)."""
+        if isinstance(source, os.PathLike):
+            source = os.fspath(source)
         if isinstance(source, MapReduce):
             self._anon_counter += 1
             name = f"_anon{self._anon_counter}"

@@ -234,6 +234,139 @@ def test_luby_find_on_mesh_backend(graph_file, tmp_path):
     assert got == oracle
 
 
+# ---------------------------------------------------------------------------
+# sssp
+# ---------------------------------------------------------------------------
+
+def dijkstra(edges_w, source):
+    """Oracle: directed single-source shortest paths, {v: (dist, pred)}."""
+    import heapq
+    adj = collections.defaultdict(list)
+    verts = set()
+    for a, b, w in edges_w:
+        adj[int(a)].append((int(b), float(w)))
+        verts.update((int(a), int(b)))
+    dist = {v: float("inf") for v in verts}
+    pred = {v: 0 for v in verts}
+    dist[source] = 0.0
+    pq = [(0.0, source)]
+    while pq:
+        d, u = heapq.heappop(pq)
+        if d > dist[u]:
+            continue
+        for v, w in adj[u]:
+            if d + w < dist[v]:
+                dist[v] = d + w
+                pred[v] = u
+                heapq.heappush(pq, (dist[v], v))
+    return {v: (dist[v], pred[v]) for v in verts}
+
+
+@pytest.fixture
+def weighted_graph_file(tmp_path, rng):
+    e = rng.integers(0, 40, size=(150, 2)).astype(np.uint64)
+    e = e[e[:, 0] != e[:, 1]]
+    _, idx = np.unique(e, axis=0, return_index=True)
+    e = e[np.sort(idx)]
+    w = rng.uniform(0.5, 5.0, size=len(e)).round(3)
+    path = tmp_path / "wgraph.txt"
+    path.write_text("\n".join(f"{a} {b} {c}" for (a, b), c
+                              in zip(e.tolist(), w.tolist())) + "\n")
+    return str(path), [(a, b, c) for (a, b), c in zip(e.tolist(), w.tolist())]
+
+
+def test_sssp_matches_dijkstra(weighted_graph_file, tmp_path):
+    path, ew = weighted_graph_file
+    out = tmp_path / "sssp.out"
+    cmd = run_command("sssp", ["1", "17"], inputs=[path],
+                      outputs=[str(out)], screen=False)
+    (source, got), = cmd.results.items()
+    oracle = dijkstra(ew, source)
+    assert set(got) == set(oracle)
+    for v in oracle:
+        assert got[v][0] == pytest.approx(oracle[v][0])
+        if np.isfinite(oracle[v][0]) and v != source:
+            # pred must realise the shortest distance (ties may differ)
+            pd = got[v][1]
+            w = min(c for a, b, c in ew if a == pd and b == v)
+            assert got[v][0] == pytest.approx(got[pd][0] + w)
+    # file round-trip
+    rows = [l.split() for l in out.read_text().splitlines()]
+    assert len(rows) == len(oracle)
+
+
+def test_sssp_multi_source_line_graph(tmp_path):
+    # 0 →1→ 1 →1→ 2 →1→ 3: distances are exact path sums
+    e = [(i, i + 1, 1.0) for i in range(6)]
+    path = tmp_path / "line.txt"
+    path.write_text("\n".join(f"{a} {b} {c}" for a, b, c in e))
+    cmd = run_command("sssp", ["3", "5"], inputs=[path], screen=False)
+    assert len(cmd.results) == 3
+    for source, got in cmd.results.items():
+        oracle = dijkstra(e, source)
+        for v in oracle:
+            assert got[v][0] == pytest.approx(oracle[v][0])
+
+
+def test_sssp_on_mesh_backend(weighted_graph_file, tmp_path):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    path, ew = weighted_graph_file
+    obj = ObjectManager(comm=make_mesh(4))
+    cmd = run_command("sssp", ["1", "17"], obj=obj, inputs=[path],
+                      screen=False)
+    (source, got), = cmd.results.items()
+    oracle = dijkstra(ew, source)
+    for v in oracle:
+        assert got[v][0] == pytest.approx(oracle[v][0])
+
+
+# ---------------------------------------------------------------------------
+# pagerank command (reference ships a stub; we assert vs dense numpy oracle)
+# ---------------------------------------------------------------------------
+
+def numpy_pagerank(src, dst, n, alpha, iters=200):
+    r = np.full(n, 1.0 / n)
+    deg = np.bincount(src, minlength=n).astype(float)
+    inv = np.where(deg > 0, 1.0 / np.maximum(deg, 1), 0.0)
+    for _ in range(iters):
+        contrib = r * inv
+        inflow = np.bincount(dst, weights=contrib[src], minlength=n)
+        dangling = r[deg == 0].sum() / n
+        r = (1 - alpha) / n + alpha * (inflow + dangling)
+    return r
+
+
+def test_pagerank_command_matches_oracle(weighted_graph_file, tmp_path):
+    path, ew = weighted_graph_file
+    out = tmp_path / "pr.out"
+    cmd = run_command("pagerank", ["1e-9", "200", "0.85"], inputs=[path],
+                      outputs=[str(out)], screen=False)
+    e = np.array([(a, b) for a, b, _ in ew], dtype=np.uint64)
+    verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+    oracle = numpy_pagerank(inv.reshape(-1, 2)[:, 0],
+                            inv.reshape(-1, 2)[:, 1], len(verts), 0.85)
+    assert cmd.nvert == len(verts)
+    got = np.array([cmd.ranks[int(v)] for v in verts])
+    np.testing.assert_allclose(got, oracle, rtol=2e-4)
+    assert abs(got.sum() - 1.0) < 1e-3
+    rows = np.loadtxt(out).reshape(-1, 2)
+    assert len(rows) == len(verts)
+
+
+def test_pagerank_command_on_mesh(weighted_graph_file):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    path, ew = weighted_graph_file
+    obj = ObjectManager(comm=make_mesh(4))
+    cmd = run_command("pagerank", ["1e-9", "200", "0.85"], obj=obj,
+                      inputs=[path], screen=False)
+    e = np.array([(a, b) for a, b, _ in ew], dtype=np.uint64)
+    verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+    oracle = numpy_pagerank(inv.reshape(-1, 2)[:, 0],
+                            inv.reshape(-1, 2)[:, 1], len(verts), 0.85)
+    got = np.array([cmd.ranks[int(v)] for v in verts])
+    np.testing.assert_allclose(got, oracle, rtol=2e-4)
+
+
 def test_neigh_tri_per_vertex_files(tri_file, tmp_path):
     path, e = tri_file
     # adjacency file from the neighbor command, triangles from tri_find
