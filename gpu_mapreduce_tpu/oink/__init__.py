@@ -4,6 +4,9 @@
 from .command import COMMANDS, Command, command, run_command
 from .objects import InputDescriptor, ObjectManager, OutputDescriptor
 from . import commands  # registers the built-in command suite
+from .script import OinkScript
+from .variables import Variables
 
 __all__ = ["COMMANDS", "Command", "command", "run_command",
-           "ObjectManager", "InputDescriptor", "OutputDescriptor"]
+           "ObjectManager", "InputDescriptor", "OutputDescriptor",
+           "OinkScript", "Variables"]

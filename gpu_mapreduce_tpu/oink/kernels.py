@@ -144,22 +144,22 @@ def read_words(itask, filename, kv, ptr):
 # edge/vertex maps (batch: fn(frame, kv, ptr))
 # ---------------------------------------------------------------------------
 
-def edge_to_vertices(fr: KVFrame, kv, ptr):
+def edge_to_vertices(fr, kv, ptr):
     """Eij:NULL → Vi:NULL and Vj:NULL (map_edge_to_vertices.cpp)."""
-    e = np.asarray(fr.key.to_host().data)
+    e = kv_keys(fr)
     both = np.concatenate([e[:, 0], e[:, 1]])
     kv.add_batch(both, _null(len(both)))
 
 
-def edge_to_vertex(fr: KVFrame, kv, ptr):
+def edge_to_vertex(fr, kv, ptr):
     """Eij:NULL → Vi:NULL only (map_edge_to_vertex.cpp)."""
-    e = np.asarray(fr.key.to_host().data)
+    e = kv_keys(fr)
     kv.add_batch(e[:, 0], _null(len(e)))
 
 
-def edge_to_vertex_pair(fr: KVFrame, kv, ptr):
+def edge_to_vertex_pair(fr, kv, ptr):
     """Eij:NULL → Vi:Vj (map_edge_to_vertex_pair.cpp)."""
-    e = np.asarray(fr.key.to_host().data)
+    e = kv_keys(fr)
     kv.add_batch(e[:, 0], e[:, 1])
 
 
@@ -172,9 +172,9 @@ def edge_both_directions(fr, kv, ptr):
                  np.concatenate([e[:, 1], e[:, 0]]))
 
 
-def edge_upper(fr: KVFrame, kv, ptr):
+def edge_upper(fr, kv, ptr):
     """Canonicalise to Vi<Vj, drop self-loops (map_edge_upper.cpp:15-24)."""
-    e = np.asarray(fr.key.to_host().data)
+    e = kv_keys(fr)
     keep = e[:, 0] != e[:, 1]
     e = e[keep]
     lo = np.minimum(e[:, 0], e[:, 1])
@@ -182,13 +182,15 @@ def edge_upper(fr: KVFrame, kv, ptr):
     kv.add_batch(np.stack([lo, hi], 1), _null(len(e)))
 
 
-def invert(fr: KVFrame, kv, ptr):
+def invert(fr, kv, ptr):
     """K:V → V:K (map_invert.cpp)."""
+    fr = host_kv(fr)
     kv.add_batch(fr.value, fr.key)
 
 
-def add_weight(fr: KVFrame, kv, ptr):
+def add_weight(fr, kv, ptr):
     """Eij:NULL → Eij:1.0 (map_add_weight.cpp — unit edge weights)."""
+    fr = host_kv(fr)
     kv.add_batch(fr.key, np.ones(len(fr), np.float64))
 
 
@@ -218,6 +220,40 @@ def value_histogram(mr) -> list:
 # ---------------------------------------------------------------------------
 # printers (reference per-command print callbacks)
 # ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# name → kernel registries (what oink/Make.py generates as style_map.h /
+# style_reduce.h: script text like `mre map/mr mre add_weight` resolves its
+# callback through these, reference oink/mrmpi.cpp:354-466)
+# ---------------------------------------------------------------------------
+
+MAP_FILE_KERNELS = {
+    "read_edge": read_edge,
+    "read_edge_weight": read_edge_weight,
+    "read_edge_label": read_edge_label,
+    "read_vertex_value": read_vertex_value,
+    "read_vertex_weight": read_vertex_weight,
+    "read_words": read_words,
+}
+
+MAP_MR_KERNELS = {
+    "edge_to_vertices": edge_to_vertices,
+    "edge_to_vertex": edge_to_vertex,
+    "edge_to_vertex_pair": edge_to_vertex_pair,
+    "edge_both_directions": edge_both_directions,
+    "edge_upper": edge_upper,
+    "invert": invert,
+    "add_weight": add_weight,
+}
+
+REDUCE_KERNELS = {
+    "count": count,
+    "cull": cull,
+    "sum": sum_values,
+    "min": min_values,
+    "max": max_values,
+}
+
 
 def print_edge(k, v, fp):
     fp.write(f"{k[0]} {k[1]}\n")

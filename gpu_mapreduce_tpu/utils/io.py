@@ -15,6 +15,7 @@ driven from the single controller process and data is *sharded later* by
 
 from __future__ import annotations
 
+import glob
 import os
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -25,6 +26,12 @@ def findfiles(paths: Sequence[str], recurse: bool = False,
     src/mapreduce.cpp:2812-2848; readflag file-of-filenames 2857-2906)."""
     out: List[str] = []
     for p in paths:
+        if any(c in p for c in "*?[") and not os.path.exists(p):
+            hits = sorted(glob.glob(p))
+            if not hits:
+                raise FileNotFoundError(p)
+            out.extend(findfiles(hits, recurse, readflag))
+            continue
         if os.path.isdir(p):
             for entry in sorted(os.listdir(p)):
                 full = os.path.join(p, entry)

@@ -1,0 +1,278 @@
+"""OINK script interpreter tests — grammar (reference oink/input.cpp),
+variables (oink/variable.cpp), named-MR dispatch (oink/mrmpi.cpp), and
+the examples/in.* integration scripts with golden invariants."""
+
+import io
+import math
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu.core.runtime import MRError
+from gpu_mapreduce_tpu.oink import OinkScript, Variables
+
+
+def run(text, **kw):
+    out = io.StringIO()
+    s = OinkScript(screen=out, **kw)
+    s.run_string(text)
+    return out.getvalue(), s
+
+
+# ---------------------------------------------------------------------------
+# variables + formula evaluator
+# ---------------------------------------------------------------------------
+
+def test_variable_styles():
+    v = Variables()
+    v.set(["a", "index", "x", "y", "z"])
+    v.set(["n", "loop", "3"])
+    v.set(["m", "loop", "5", "8"])
+    v.set(["p", "loop", "12", "pad"])
+    v.set(["s", "string", "hello world"])
+    assert v.retrieve("a") == "x"
+    assert v.retrieve("n") == "1"
+    assert v.retrieve("m") == "5"
+    assert v.retrieve("p") == "01"          # padded to len("12")
+    assert v.retrieve("s") == "hello world"
+    # first definition wins for index/loop (variable.cpp:113)
+    v.set(["a", "index", "other"])
+    assert v.retrieve("a") == "x"
+    # next advances and removes on exhaustion
+    assert v.next(["n"]) is False
+    assert v.retrieve("n") == "2"
+    assert v.next(["n"]) is False
+    assert v.next(["n"]) is True
+    assert v.retrieve("n") is None
+
+
+def test_variable_equal_formulas():
+    v = Variables()
+    cases = {
+        "1+2*3": 7, "(1+2)*3": 9, "2^3^2": 512,      # ^ right-assoc
+        "-2^2": 4,          # UNARY binds tighter than ^ (variable.cpp:68)
+        "10/4": 2.5, "sqrt(16)+ln(exp(2))": 6,
+        "PI": math.pi, "floor(2.7)+ceil(2.1)+round(2.5)": 8,
+        "1 < 2 && 2 <= 2": 1, "1 > 2 || 0": 0, "!0": 1,
+        "3 == 3": 1, "3 != 3": 0, "atan2(0,1)": 0,
+    }
+    for f, want in cases.items():
+        assert v.evaluate(f) == pytest.approx(want), f
+    v.set(["x", "equal", "6*7"])
+    assert v.evaluate("v_x + 1") == 43
+    with pytest.raises(MRError):
+        v.evaluate("nosuchkeyword")
+    with pytest.raises(MRError):
+        v.evaluate("1 +")
+
+
+def test_variable_equal_reset_and_style_guard():
+    v = Variables()
+    v.set(["e", "equal", "1"])
+    v.set(["e", "equal", "2"])               # EQUAL may be reset
+    assert v.retrieve("e") == "2"
+    # index over an existing name is a silent no-op (variable.cpp:114)
+    v.set(["e", "index", "q"])
+    assert v.retrieve("e") == "2"
+    with pytest.raises(MRError):
+        v.set(["e", "string", "q"])          # string/equal cross-reset
+    v.set(["e", "delete"])
+    v.set(["e", "index", "q"])
+    assert v.retrieve("e") == "q"
+
+
+# ---------------------------------------------------------------------------
+# interpreter grammar
+# ---------------------------------------------------------------------------
+
+def test_substitution_comments_quotes():
+    out, _ = run('variable x index abc\n'
+                 'print "x=$x brace=${x}"  # trailing comment\n'
+                 "print 'hash # inside quotes survives'\n")
+    assert "x=abc brace=abc" in out
+    assert "hash # inside quotes survives" in out
+
+
+def test_continuation_lines():
+    out, _ = run('variable x index abc\nprint &\n"joined $x"\n')
+    assert "joined abc" in out
+
+
+def test_if_elif_else():
+    out, _ = run('if "1 > 2" then "print A" elif "2 > 1" "print B" '
+                 'else "print C"\n')
+    assert "B" in out and "A" not in out and "C" not in out
+    out, _ = run('if "0" then "print A" else "print C1" "print C2"\n')
+    assert "C1" in out and "C2" in out
+
+
+def test_label_next_jump_loop():
+    out, _ = run("variable i loop 4\n"
+                 "label top\n"
+                 'print "i=$i"\n'
+                 "next i\n"
+                 "jump SELF top\n"
+                 'print "done"\n')
+    for k in (1, 2, 3, 4):
+        assert f"i={k}" in out
+    assert "done" in out
+    assert out.count("i=4") == 1
+
+
+def test_unknown_command_and_bad_substitution():
+    with pytest.raises(MRError, match="Unknown command"):
+        run("frobnicate 1 2\n")
+    with pytest.raises(MRError, match="illegal variable"):
+        run('print "$q"\n')
+
+
+def test_shell_and_log(tmp_path):
+    d = tmp_path / "sub"
+    out, s = run(f"shell mkdir {d}\n"
+                 f"log {tmp_path}/my.log\n"
+                 'print "to the log"\n')
+    s.close()
+    assert d.is_dir()
+    assert "to the log" in (tmp_path / "my.log").read_text()
+
+
+# ---------------------------------------------------------------------------
+# mr objects + named-MR method dispatch (oink/mrmpi.cpp)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def edge_file(tmp_path, rng):
+    e = rng.integers(0, 20, size=(60, 2)).astype(np.uint64)
+    e = e[e[:, 0] != e[:, 1]]
+    p = tmp_path / "edges.txt"
+    p.write_text("\n".join(f"{a} {b}" for a, b in e) + "\n")
+    return str(p), e
+
+
+def test_mr_create_and_methods(edge_file):
+    path, e = edge_file
+    out, s = run(f"mr work\n"
+                 f"work map/file {path} read_edge\n"
+                 f"work map/mr work edge_to_vertices\n"
+                 f"work collate NULL\n"
+                 f"work reduce count\n")
+    mr = s.obj.get_mr("work")
+    got = {}
+    mr.scan_kv(lambda k, v, p: got.__setitem__(int(k), int(v)))
+    import collections
+    oracle = collections.Counter(
+        np.concatenate([e[:, 0], e[:, 1]]).tolist())
+    assert got == dict(oracle)
+
+
+def test_mr_copy_add_delete(edge_file):
+    path, _ = edge_file
+    _, s = run(f"mr a\n"
+               f"a map/file {path} read_edge\n"
+               f"a copy b\n"
+               f"b add a\n")
+    na = s.obj.get_mr("a").kv.nkv
+    assert s.obj.get_mr("b").kv.nkv == 2 * na
+    s.one("a delete")
+    with pytest.raises(MRError):
+        s.obj.get_mr("a")
+
+
+def test_mr_command_errors(edge_file):
+    path, _ = edge_file
+    _, s = run("mr a\n")
+    with pytest.raises(MRError, match="already in use"):
+        s.one("mr a")
+    with pytest.raises(MRError, match="alphanumeric"):
+        s.one("mr bad-name")
+    with pytest.raises(MRError, match="Unknown MR object method"):
+        s.one("a frobnicate")
+    s.one(f"a map/file {path} read_edge")
+    with pytest.raises(MRError, match="unknown reduce kernel"):
+        s.one("a compress nosuchkernel")
+
+
+# ---------------------------------------------------------------------------
+# registered-command dispatch with -i/-o (input.cpp:429-468)
+# ---------------------------------------------------------------------------
+
+def test_command_with_io_switches(edge_file, tmp_path):
+    path, e = edge_file
+    outfile = tmp_path / "upper.txt"
+    out, s = run(f"edge_upper -i {path} -o {outfile} mru\n"
+                 f"degree 0 -i mru\n")
+    got = np.loadtxt(outfile, dtype=np.uint64).reshape(-1, 2)
+    assert np.all(got[:, 0] < got[:, 1])
+    assert "mru" in s.obj.named
+
+
+def test_v_files_variable_input(tmp_path, rng):
+    words = ["alpha", "beta", "beta", "gamma"] * 10
+    f1, f2 = tmp_path / "w1.txt", tmp_path / "w2.txt"
+    f1.write_text(" ".join(words))
+    f2.write_text(" ".join(words))
+    out, s = run(f"variable files index {f1} {f2}\n"
+                 f"wordfreq 2 -i v_files\n")
+    assert "2 files, 80 words, 3 unique" in out
+    assert "40 beta" in out
+
+
+def test_set_scratch_maps_to_fpath(tmp_path):
+    _, s = run(f"set scratch {tmp_path} verbosity 0\n"
+               f"mr w\n")
+    assert s.obj.get_mr("w").settings.fpath == str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# examples/in.* integration (the reference's own acceptance style:
+# printed invariants, SURVEY.md §4.1)
+# ---------------------------------------------------------------------------
+
+def test_example_in_cc_golden(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.run_file("/root/repo/examples/in.cc")
+    text = out.getvalue()
+    assert "RMAT: 65536 rows, 131072 non-zeroes" in text
+    assert "CC_find: 42 components in 8 iterations" in text
+    assert "CCStats: 42 components, 64343 vertices" in text
+    assert (tmp_path / "tmp.cc").exists()
+
+
+def test_example_in_luby_golden(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.run_file("/root/repo/examples/in.luby")
+    text = out.getvalue()
+    assert "RMAT: 4096 rows, 16384 non-zeroes" in text
+    assert "Luby_find: 1123 MIS vertices in 4 iterations" in text
+
+
+def test_example_in_sssp_named_mr_weighting(tmp_path, monkeypatch):
+    # in.sssp drives `mre map/mr mre add_weight` through named-MR dispatch
+    monkeypatch.chdir(tmp_path)
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.run_file("/root/repo/examples/in.sssp")
+    text = out.getvalue()
+    assert text.count("SSSP: source") == 10
+    assert (tmp_path / "tmp.sssp.0").exists()
+
+
+def test_main_cli(tmp_path, monkeypatch, capsys):
+    from gpu_mapreduce_tpu.oink.script import main
+    monkeypatch.chdir(tmp_path)
+    words = tmp_path / "w.txt"
+    words.write_text("a b a c a b " * 5)
+    script = tmp_path / "in.test"
+    script.write_text("wordfreq 2 -i v_files\n"
+                      'print "done on $p procs"\n')
+    rc = main(["-in", str(script), "-log", str(tmp_path / "log.oink"),
+               "-var", "files", str(words), "-var", "p", "1",
+               "-echo", "log"])
+    assert rc == 0
+    log = (tmp_path / "log.oink").read_text()
+    assert "done on 1 procs" in log
+    assert "wordfreq 2 -i v_files" in log    # echo log mode
