@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.mapreduce import MapReduce
-from ..ops.hash import hash_bytes64
+from .. import native
+from ..ops.hash import hash_bytes64_batch
 from ..ops.pallas.match import url_lengths
 from ..utils.io import findfiles
 
@@ -106,13 +107,24 @@ class InvertedIndex:
     """Builds an inverted URL→documents index over the MapReduce algebra."""
 
     def __init__(self, comm=None, use_pallas: Optional[bool] = None,
-                 interpret: Optional[bool] = None):
+                 interpret: Optional[bool] = None,
+                 engine: Optional[str] = None):
+        """engine: 'pallas' (TPU kernels, default), 'xla' (jnp fallback),
+        or 'native' (the C++ scanner of native/mrnative.cpp — the moral
+        equivalent of the reference's cpu/InvertedIndex.cpp FSM baseline,
+        and the host fallback when no accelerator is worth dispatching
+        to)."""
         backend = jax.default_backend()
-        if use_pallas is None:
-            use_pallas = True
+        if engine is None:
+            engine = "pallas" if (use_pallas or use_pallas is None) \
+                else "xla"
+        if engine == "native" and not native.available():
+            raise RuntimeError(f"native engine unavailable: "
+                               f"{native.build_error()}")
+        self.engine = engine
+        self.use_pallas = engine == "pallas"
         if interpret is None:
             interpret = backend != "tpu"  # CPU tests interpret the kernel
-        self.use_pallas = use_pallas
         self.interpret = interpret
         self.comm = comm
         self.urls: Dict[int, bytes] = {}
@@ -127,22 +139,22 @@ class InvertedIndex:
         self.docs.append(filename)
         if len(data) == 0:
             return
-        starts, lengths = _device_extract(data, self.use_pallas, self.interpret)
-        ids = np.empty(len(starts), np.uint64)
-        keep = np.ones(len(starts), bool)
-        for i, (st, ln) in enumerate(zip(starts, lengths)):
-            if ln < 0:
-                keep[i] = False  # unterminated href — reference runs off; we drop
-                continue
-            url = data[st:st + ln].tobytes()  # slice from the host buffer
-            h = hash_bytes64(url)
+        if self.engine == "native":
+            starts, lengths = native.find_hrefs(data.tobytes())
+            lengths = np.minimum(lengths, MAX_URL)  # device path's URL cap
+        else:
+            starts, lengths = _device_extract(data, self.use_pallas,
+                                              self.interpret)
+        keep = lengths >= 0  # unterminated href — reference runs off; we drop
+        urls = [data[st:st + ln].tobytes()
+                for st, ln in zip(starts[keep], lengths[keep])]
+        ids = hash_bytes64_batch(urls)  # native C++ batch intern
+        for h, url in zip(ids.tolist(), urls):
             prev = self.urls.get(h)
             if prev is not None and prev != url:
                 raise ValueError(f"64-bit URL intern collision: {prev!r} vs {url!r}")
             self.urls[h] = url
-            ids[i] = h
-        kv.add_batch(ids[keep],
-                     np.full(int(keep.sum()), doc_id, dtype=np.uint32))
+        kv.add_batch(ids, np.full(len(ids), doc_id, dtype=np.uint32))
 
     # -- full pipeline ---------------------------------------------------
     def run(self, paths: Sequence[str], outdir: Optional[str] = None,

@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..ops.hash import hash_bytes64
+from ..ops.hash import hash_bytes64, hash_bytes64_batch
 
 ArrayLike = Union[np.ndarray, jax.Array]
 
@@ -144,15 +144,14 @@ class BytesColumn(Column):
 
         Returns ``(DenseColumn[uint64], {id: bytes})``.  Raises on a 64-bit
         collision between distinct strings (probability ~n^2/2^64)."""
-        ids = np.empty(len(self.data), dtype=np.uint64)
+        strings = [bytes(s) for s in self.data]
+        ids = hash_bytes64_batch(strings)
         table: Dict[int, bytes] = {}
-        for i, s in enumerate(self.data):
-            h = hash_bytes64(s)
+        for h, s in zip(ids.tolist(), strings):
             prev = table.get(h)
             if prev is not None and prev != s:
                 raise ValueError("64-bit intern collision between %r and %r" % (prev, s))
             table[h] = s
-            ids[i] = h
         return DenseColumn(ids), table
 
     def __repr__(self):

@@ -1,0 +1,161 @@
+"""Native C++ host runtime — ctypes loader and numpy-facing wrappers.
+
+The reference's host hot paths are C++ (hashing ``src/hash.cpp``, file
+parsing ``oink/map_read_*.cpp``, the InvertedIndex FSM
+``cpu/InvertedIndex.cpp``); ours live in ``mrnative.cpp`` next to this
+file, compiled lazily with the baked-in ``g++`` the first time the
+package is imported (no pybind11 in the image — plain ``extern "C"`` +
+ctypes, see environment notes).  Every wrapper has a pure-Python/numpy
+fallback, so the framework works identically when no compiler exists —
+``available()`` tells which path is live, and callers (ops/hash.py,
+oink/kernels.py, apps/invertedindex.py) branch on it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "mrnative.cpp")
+_SO = os.path.join(_DIR, f"mrnative-{sys.implementation.cache_tag}.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile mrnative.cpp → .so; returns an error string or None."""
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"{cxx}: {e}"
+    if proc.returncode != 0:
+        return proc.stderr.strip() or f"{cxx} failed"
+    return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _build_error
+    have_src = os.path.exists(_SRC)
+    stale = (have_src and os.path.exists(_SO)
+             and os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+    if not os.path.exists(_SO) or stale:
+        if not have_src:  # .so absent and nothing to build from
+            _build_error = f"{_SRC} missing"
+            return None
+        _build_error = _build()
+        if _build_error is not None:
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:  # pragma: no cover
+        _build_error = str(e)
+        return None
+    i64, u32, u64 = ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint64
+    p = ctypes.POINTER
+    u8p = p(ctypes.c_uint8)
+    lib.mr_hashlittle.restype = u32
+    lib.mr_hashlittle.argtypes = [u8p, i64, u32]
+    lib.mr_hashlittle_batch.restype = None
+    lib.mr_hashlittle_batch.argtypes = [u8p, p(i64), i64, u32, p(u32)]
+    lib.mr_intern64_batch.restype = None
+    lib.mr_intern64_batch.argtypes = [u8p, p(i64), i64, p(u64)]
+    lib.mr_parse_table.restype = i64
+    lib.mr_parse_table.argtypes = [u8p, i64, i64, p(ctypes.c_int32),
+                                   p(ctypes.c_void_p), i64]
+    lib.mr_find_hrefs.restype = i64
+    lib.mr_find_hrefs.argtypes = [u8p, i64, p(i64), p(i64), i64]
+    return lib
+
+
+def available() -> bool:
+    return _lib is not None
+
+
+def build_error() -> Optional[str]:
+    return _build_error
+
+
+def _u8(buf: bytes):
+    return ctypes.cast(ctypes.c_char_p(buf), ctypes.POINTER(ctypes.c_uint8))
+
+
+def _arr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# wrappers (callers must check available() first)
+# ---------------------------------------------------------------------------
+
+def hashlittle(data: bytes, initval: int = 0) -> int:
+    return int(_lib.mr_hashlittle(_u8(data), len(data), initval))
+
+
+def hashlittle_batch(buf: bytes, offsets: np.ndarray,
+                     initval: int = 0) -> np.ndarray:
+    """Hash n packed byte strings; offsets is int64[n+1]."""
+    n = len(offsets) - 1
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    out = np.empty(n, np.uint32)
+    _lib.mr_hashlittle_batch(_u8(buf), _arr(offsets, ctypes.c_int64), n,
+                             initval, _arr(out, ctypes.c_uint32))
+    return out
+
+
+def intern64_batch(buf: bytes, offsets: np.ndarray) -> np.ndarray:
+    """String → u64 intern ids (ops/hash.py hash_bytes64 semantics)."""
+    n = len(offsets) - 1
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    out = np.empty(n, np.uint64)
+    _lib.mr_intern64_batch(_u8(buf), _arr(offsets, ctypes.c_int64), n,
+                           _arr(out, ctypes.c_uint64))
+    return out
+
+
+def parse_table(buf: bytes, dtypes) -> List[np.ndarray]:
+    """Parse a whitespace table of len(dtypes) columns; dtype entries are
+    np.uint64 or np.float64.  Returns one array per column; raises
+    ValueError on malformed input (same contract as kernels._parse_cols)."""
+    ncols = len(dtypes)
+    spec = np.array([0 if dt == np.uint64 else 1 for dt in dtypes],
+                    np.int32)
+    cap = max(16, len(buf) // (2 * ncols))
+    while True:
+        cols = [np.empty(cap, dt) for dt in dtypes]
+        ptrs = (ctypes.c_void_p * ncols)(
+            *[c.ctypes.data_as(ctypes.c_void_p) for c in cols])
+        n = _lib.mr_parse_table(_u8(buf), len(buf), ncols,
+                                _arr(spec, ctypes.c_int32), ptrs, cap)
+        if n == -1:
+            raise ValueError("malformed numeric table")
+        if n >= 0:
+            return [c[:n] for c in cols]
+        cap = -n
+
+
+def find_hrefs(buf: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    """URL (starts, lens) of every `<a href="..."` match — the host
+    equivalent of the Pallas mark/extract pipeline."""
+    cap = max(16, len(buf) // 64)
+    while True:
+        starts = np.empty(cap, np.int64)
+        lens = np.empty(cap, np.int64)
+        n = _lib.mr_find_hrefs(_u8(buf), len(buf),
+                               _arr(starts, ctypes.c_int64),
+                               _arr(lens, ctypes.c_int64), cap)
+        if n >= 0:
+            return starts[:n], lens[:n]
+        cap = -n
+
+
+_lib = _load()

@@ -1,0 +1,189 @@
+// mrnative — host-side C++ runtime for the TPU MapReduce framework.
+//
+// The reference keeps its host hot paths in C++: lookup3 hashing
+// (src/hash.cpp), byte-packed KV ingestion (src/keyvalue.cpp), file/word
+// parsing in map callbacks (oink/map_read_*.cpp), and the CPU
+// InvertedIndex href FSM (cpu/InvertedIndex.cpp:144-265).  This library is
+// their TPU-framework equivalent: the device work is JAX/Pallas, and the
+// host-side ingestion/hashing that feeds it runs here instead of in
+// Python loops.  Python binds via ctypes (gpu_mapreduce_tpu/native/
+// __init__.py); every entry point is extern "C" with flat buffers.
+//
+// Build: g++ -O3 -shared -fPIC mrnative.cpp -o mrnative.so  (done lazily
+// by the loader; no external dependencies).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// lookup3 hashlittle (Bob Jenkins, public domain algorithm; reference
+// src/hash.cpp:104-228).  Byte-at-a-time formulation — bit-identical to
+// the aligned-read C original on little-endian hosts and to the Python
+// port in ops/hash.py.
+// ---------------------------------------------------------------------------
+
+inline uint32_t rot(uint32_t x, int k) { return (x << k) | (x >> (32 - k)); }
+
+inline void mix(uint32_t &a, uint32_t &b, uint32_t &c) {
+  a -= c; a ^= rot(c, 4);  c += b;
+  b -= a; b ^= rot(a, 6);  a += c;
+  c -= b; c ^= rot(b, 8);  b += a;
+  a -= c; a ^= rot(c, 16); c += b;
+  b -= a; b ^= rot(a, 19); a += c;
+  c -= b; c ^= rot(b, 4);  b += a;
+}
+
+inline void final_mix(uint32_t &a, uint32_t &b, uint32_t &c) {
+  c ^= b; c -= rot(b, 14);
+  a ^= c; a -= rot(c, 11);
+  b ^= a; b -= rot(a, 25);
+  c ^= b; c -= rot(b, 16);
+  a ^= c; a -= rot(c, 4);
+  b ^= a; b -= rot(a, 14);
+  c ^= b; c -= rot(b, 24);
+}
+
+inline uint32_t load_le32(const uint8_t *p, int64_t avail) {
+  uint32_t v = 0;
+  for (int i = 0; i < 4 && i < avail; i++) v |= uint32_t(p[i]) << (8 * i);
+  return v;
+}
+
+uint32_t hashlittle(const uint8_t *key, int64_t length, uint32_t initval) {
+  uint32_t a, b, c;
+  a = b = c = 0xDEADBEEFu + uint32_t(length) + initval;
+  const uint8_t *k = key;
+  while (length > 12) {
+    a += load_le32(k, 4);
+    b += load_le32(k + 4, 4);
+    c += load_le32(k + 8, 4);
+    mix(a, b, c);
+    k += 12;
+    length -= 12;
+  }
+  if (length == 0) return c;
+  a += load_le32(k, length);
+  b += load_le32(k + 4, length - 4);
+  c += load_le32(k + 8, length - 8);
+  final_mix(a, b, c);
+  return c;
+}
+
+inline bool is_space(uint8_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v';
+}
+
+}  // namespace
+
+extern "C" {
+
+// single hash (parity with ops/hash.py hashlittle)
+uint32_t mr_hashlittle(const uint8_t *key, int64_t len, uint32_t initval) {
+  return hashlittle(key, len, initval);
+}
+
+// hash n byte strings packed in `buf` at `offsets` (n+1 entries) → u32
+void mr_hashlittle_batch(const uint8_t *buf, const int64_t *offsets,
+                         int64_t n, uint32_t initval, uint32_t *out) {
+  for (int64_t i = 0; i < n; i++)
+    out[i] = hashlittle(buf + offsets[i], offsets[i + 1] - offsets[i],
+                        initval);
+}
+
+// 64-bit intern ids: (hashlittle(s,0) << 32) | hashlittle(s,0xDEADBEEF)
+// (ops/hash.py hash_bytes64 — string→u64 interning for the device path)
+void mr_intern64_batch(const uint8_t *buf, const int64_t *offsets,
+                       int64_t n, uint64_t *out) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t *p = buf + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    uint64_t hi = hashlittle(p, len, 0);
+    uint64_t lo = hashlittle(p, len, 0xDEADBEEFu);
+    out[i] = (hi << 32) | lo;
+  }
+}
+
+// numeric table parser (read_edge / read_edge_weight ingestion):
+// whitespace-separated tokens parsed round-robin per column; colspec[j]:
+// 0 = u64 (exact integer parse), 1 = f64 (strtod).  cols[j] points at a
+// u64- or f64-sized output array with capacity maxrows.  Returns row
+// count, -1 on malformed input (bad char / token count not divisible),
+// or -needed when maxrows is too small.
+int64_t mr_parse_table(const uint8_t *buf, int64_t len, int64_t ncols,
+                       const int32_t *colspec, void **cols,
+                       int64_t maxrows) {
+  int64_t ntok = 0, i = 0;
+  while (i < len) {
+    while (i < len && is_space(buf[i])) i++;
+    if (i >= len) break;
+    int64_t s = i;
+    while (i < len && !is_space(buf[i])) i++;
+    int64_t col = ntok % ncols, row = ntok / ncols;
+    if (row < maxrows) {
+      if (colspec[col] == 0) {
+        if (i - s == 0 || i - s > 20) return -1;  // u64 max is 20 digits
+        uint64_t v = 0;
+        for (int64_t p = s; p < i; p++) {
+          uint8_t c = buf[p];
+          if (c < '0' || c > '9') return -1;
+          uint64_t next = v * 10u + (c - '0');
+          if (next / 10u != v) return -1;         // overflow: error, never
+          v = next;                               // wrap (fallback raises)
+        }
+        ((uint64_t *)cols[col])[row] = v;
+      } else {
+        char tmp[64];
+        if (i - s == 0 || i - s >= 63) return -1;  // no f64 literal needs more
+        int64_t tl = i - s;
+        // decimal literals only — strtod alone would accept hex/inf/nan
+        // that the numpy fallback rejects
+        for (int64_t p = 0; p < tl; p++) {
+          char c = buf[s + p];
+          if (!((c >= '0' && c <= '9') || c == '.' || c == '+' ||
+                c == '-' || c == 'e' || c == 'E'))
+            return -1;
+        }
+        memcpy(tmp, buf + s, tl);
+        tmp[tl] = '\0';
+        char *endp = nullptr;
+        double v = strtod(tmp, &endp);
+        // full-token consumption: '1.5abc' is malformed like the fallback
+        if (endp != tmp + tl) return -1;
+        ((double *)cols[col])[row] = v;
+      }
+    }
+    ntok++;
+  }
+  if (ntok % ncols) return -1;
+  int64_t rows = ntok / ncols;
+  return rows <= maxrows ? rows : -rows;
+}
+
+// href-URL extraction — the host equivalent of the CUDA mark /
+// compute_url_length kernels (cuda/InvertedIndex.cu:79-135) and the CPU
+// FSM parser (cpu/InvertedIndex.cpp:144-265): find every `<a href="`,
+// record the URL [start,len) up to the closing quote.  Returns count or
+// -needed.
+int64_t mr_find_hrefs(const uint8_t *buf, int64_t len, int64_t *starts,
+                      int64_t *lens, int64_t max) {
+  static const char pat[] = "<a href=\"";
+  const int64_t plen = 9;
+  int64_t n = 0;
+  for (int64_t i = 0; i + plen <= len; i++) {
+    if (memcmp(buf + i, pat, plen) != 0) continue;
+    int64_t s = i + plen;
+    int64_t e = s;
+    while (e < len && buf[e] != '"') e++;
+    if (e >= len) break;
+    if (n < max) { starts[n] = s; lens[n] = e - s; }
+    n++;
+    i = e;  // resume after the URL (matches never overlap)
+  }
+  return n <= max ? n : -n;
+}
+
+}  // extern "C"

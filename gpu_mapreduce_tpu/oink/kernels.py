@@ -88,9 +88,19 @@ def group_any(cond: np.ndarray, fr) -> np.ndarray:
 
 def _parse_cols(filename: str, dtypes) -> list:
     """Whitespace table → one exact-dtype array per column (u64 vertex ids
-    parse as integers, never through float — ids ≥ 2^53 stay exact)."""
+    parse as integers, never through float — ids ≥ 2^53 stay exact).
+    Routed through the native C++ parser when built (ingestion is a host
+    hot path; the reference parses in C callbacks, oink/map_read_*.cpp)."""
     with open(filename, "rb") as f:
-        toks = np.asarray(f.read().split())
+        raw = f.read()
+    from .. import native
+    if native.available() and all(dt in (np.uint64, np.float64)
+                                  for dt in dtypes):
+        try:
+            return native.parse_table(raw, dtypes)
+        except ValueError as e:
+            raise ValueError(f"{filename}: {e}")
+    toks = np.asarray(raw.split())
     ncols = len(dtypes)
     if len(toks) % ncols:
         raise ValueError(f"{filename}: token count not divisible by {ncols}")

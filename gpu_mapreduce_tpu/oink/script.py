@@ -207,14 +207,14 @@ class OinkScript:
         while i < len(rest):
             if rest[i] == "-i":
                 j = i + 1
-                while j < len(rest) and rest[j] != "-o":
+                while j < len(rest) and rest[j] not in ("-i", "-o"):
                     j += 1
                 for a in rest[i + 1:j]:
                     self._add_input(a)
                 i = j
             elif rest[i] == "-o":
                 j = i + 1
-                while j < len(rest) and rest[j] != "-i":
+                while j < len(rest) and rest[j] not in ("-i", "-o"):
                     j += 1
                 pairs = rest[i + 1:j]
                 if len(pairs) % 2:

@@ -98,6 +98,21 @@ def hash_bytes64(data: bytes) -> int:
     return (hi << 32) | lo
 
 
+def hash_bytes64_batch(strings) -> np.ndarray:
+    """Vector hash_bytes64 over a sequence of byte strings — routed
+    through the native C++ runtime when built (the reference's host
+    hashing is C++, src/hash.cpp; our interning loops were the last
+    per-item Python hot paths)."""
+    from .. import native
+    if native.available() and len(strings):
+        lens = np.fromiter((len(s) for s in strings), np.int64,
+                           count=len(strings))
+        offs = np.zeros(len(strings) + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        return native.intern64_batch(b"".join(strings), offs)
+    return np.array([hash_bytes64(s) for s in strings], np.uint64)
+
+
 # ---------------------------------------------------------------------------
 # Vectorised JAX version for fixed-width keys
 # ---------------------------------------------------------------------------

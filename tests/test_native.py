@@ -1,0 +1,110 @@
+"""Native C++ runtime parity tests — every mrnative entry point against
+its Python/numpy reference implementation (the reference's equivalent
+host paths: src/hash.cpp, oink/map_read_*.cpp, cpu/InvertedIndex.cpp)."""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu import native
+from gpu_mapreduce_tpu.ops.hash import (hash_bytes64, hash_bytes64_batch,
+                                        hashlittle)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native lib unavailable: {native.build_error()}")
+
+
+def test_hashlittle_parity_random():
+    rnd = random.Random(7)
+    for _ in range(300):
+        data = bytes(rnd.randrange(256) for _ in range(rnd.randrange(50)))
+        iv = rnd.randrange(2 ** 32)
+        assert native.hashlittle(data, iv) == hashlittle(data, iv)
+
+
+def test_hashlittle_batch_and_intern():
+    words = [b"alpha", b"", b"x" * 13, b"mixed bytes\x00\xff", b"q"]
+    buf = b"".join(words)
+    offs = np.cumsum([0] + [len(w) for w in words]).astype(np.int64)
+    assert native.hashlittle_batch(buf, offs, 9).tolist() == \
+        [hashlittle(w, 9) for w in words]
+    assert native.intern64_batch(buf, offs).tolist() == \
+        [hash_bytes64(w) for w in words]
+
+
+def test_hash_bytes64_batch_routes_native():
+    words = [bytes([i]) * (i % 7) for i in range(64)]
+    got = hash_bytes64_batch(words)
+    assert got.tolist() == [hash_bytes64(w) for w in words]
+
+
+def test_parse_table_rejects_overflow_and_partial_tokens():
+    # > 2^64-1 must error (the numpy fallback raises OverflowError)
+    with pytest.raises(ValueError):
+        native.parse_table(b"99999999999999999999999 1\n",
+                           (np.uint64, np.uint64))
+    with pytest.raises(ValueError):
+        native.parse_table(b"1 1.5abc\n", (np.uint64, np.float64))
+    with pytest.raises(ValueError):
+        native.parse_table(b"1 0x10\n", (np.uint64, np.float64))
+
+
+def test_invertedindex_native_engine(tmp_path):
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+    html = b'<a href="http://a/1">x</a><p><a href="http://b/2">y</a>'
+    f = tmp_path / "part-00000"
+    f.write_bytes(html)
+    app = InvertedIndex(engine="native")
+    nhits, nurls = app.run([str(f)], outdir=str(tmp_path / "out"))
+    assert (nhits, nurls) == (2, 2)
+    lines = sorted((tmp_path / "out").glob("*"))
+    text = "".join(p.read_text() for p in lines)
+    assert "http://a/1" in text and "http://b/2" in text
+
+
+def test_parse_table_u64_exact_and_f64():
+    tbl = b"1 2 3.5\n18446744073709551615 7 0.25\n 0 0 1e3 "
+    u1, u2, f = native.parse_table(tbl, (np.uint64, np.uint64, np.float64))
+    assert u1.tolist() == [1, 18446744073709551615, 0]   # 2^64-1 exact
+    assert u2.tolist() == [2, 7, 0]
+    assert f.tolist() == [3.5, 0.25, 1000.0]
+    with pytest.raises(ValueError):
+        native.parse_table(b"1 2\n3\n", (np.uint64, np.uint64))
+    with pytest.raises(ValueError):
+        native.parse_table(b"1 x\n", (np.uint64, np.uint64))
+
+
+def test_parse_table_capacity_retry():
+    n = 5000
+    tbl = b"\n".join(b"%d %d" % (i, i * 2) for i in range(n))
+    a, b = native.parse_table(tbl, (np.uint64, np.uint64))
+    assert a.tolist() == list(range(n))
+    assert b.tolist() == [2 * i for i in range(n)]
+
+
+def test_find_hrefs_matches_regex():
+    rnd = random.Random(11)
+    parts = []
+    urls = []
+    for i in range(100):
+        u = b"http://site%d/p%d" % (i, rnd.randrange(1000))
+        urls.append(u)
+        parts.append(b'<p>junk<a href="%s">t</a>' % u)
+    html = b"<html>" + b"".join(parts) + b'<a href="noquote'
+    s, l = native.find_hrefs(html)
+    got = [html[a:a + b] for a, b in zip(s, l)]
+    oracle = re.findall(rb'<a href="([^"]*)"', html)
+    assert got == oracle == urls
+
+
+def test_kernels_parse_cols_native_path(tmp_path):
+    from gpu_mapreduce_tpu.oink.kernels import _parse_cols
+    p = tmp_path / "e.txt"
+    p.write_text("5 6 1.5\n18446744073709551615 2 0.25\n")
+    vi, vj, w = _parse_cols(str(p), (np.uint64, np.uint64, np.float64))
+    assert vi.tolist() == [5, 18446744073709551615]
+    assert vj.tolist() == [6, 2]
+    assert w.tolist() == [1.5, 0.25]
