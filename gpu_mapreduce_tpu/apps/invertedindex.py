@@ -140,8 +140,10 @@ class InvertedIndex:
         if len(data) == 0:
             return
         if self.engine == "native":
-            starts, lengths = native.find_hrefs(data.tobytes())
-            lengths = np.minimum(lengths, MAX_URL)  # device path's URL cap
+            starts, lengths = native.find_hrefs(data)
+            # device path drops URLs with no terminator within MAX_URL;
+            # match that instead of silently truncating
+            lengths = np.where(lengths > MAX_URL, -1, lengths)
         else:
             starts, lengths = _device_extract(data, self.use_pallas,
                                               self.interpret)

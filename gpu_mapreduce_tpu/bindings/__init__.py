@@ -1,0 +1,52 @@
+"""Bindings layer — the C ABI over the framework.
+
+* ``cmapreduce.h`` / ``cmapreduce.c`` — flat ``MR_*`` C interface with C
+  function-pointer callbacks (reference ``src/cmapreduce.{h,cpp}``) plus
+  the ``OINK_*`` script driver (reference ``oink/library.{h,cpp}``); the
+  shim embeds CPython and forwards to :mod:`.cbridge`.
+* ``examples/cwordfreq.c`` — the reference's ``examples/cwordfreq.c``
+  workload through this API.
+
+The Python API needs no binding: the framework *is* Python-first (the
+reference's ``python/mrmpi.py`` ctypes+pickle wrapper is this package's
+moral ancestor, inverted).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+from typing import List, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def embed_flags() -> List[str]:
+    """Compiler/linker flags to embed this CPython (what
+    ``python3-config --includes --ldflags --embed`` prints)."""
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    libpl = sysconfig.get_config_var("LIBPL")  # static builds keep
+    ver = sysconfig.get_config_var("LDVERSION")  # libpython here
+    flags = [f"-I{inc}", f"-L{libdir}"]
+    if libpl:
+        flags.append(f"-L{libpl}")
+    flags += [f"-lpython{ver}", "-ldl", "-lm", f"-Wl,-rpath,{libdir}"]
+    return flags
+
+
+def build_example(name: str, out: Optional[str] = None,
+                  cc: Optional[str] = None) -> str:
+    """Compile bindings/examples/<name>.c + cmapreduce.c into an
+    executable; returns its path.  Raises RuntimeError with the compiler
+    output on failure."""
+    cc = cc or os.environ.get("CC", "gcc")
+    src = os.path.join(_DIR, "examples", f"{name}.c")
+    shim = os.path.join(_DIR, "cmapreduce.c")
+    out = out or os.path.join(_DIR, "examples", name)
+    cmd = [cc, "-O2", src, shim] + embed_flags() + ["-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{' '.join(cmd)}\n{proc.stderr}")
+    return out

@@ -1,0 +1,235 @@
+"""Python side of the C ABI — handle table + C-callback trampolines.
+
+The reference's C interface (``src/cmapreduce.{h,cpp}``) wraps the C++
+MapReduce class in flat ``MR_*`` functions over ``void*`` handles, with
+user callbacks as C function pointers.  Our engine is Python, so the
+shim inverts: ``bindings/cmapreduce.c`` embeds CPython and forwards every
+call here; C callback pointers arrive as integers and are invoked back
+through ``ctypes.CFUNCTYPE`` with the reference's byte-oriented
+signatures (map ``(itask, kv, ptr)`` / file map ``(itask, fname, kv,
+ptr)`` / reduce ``(key, keybytes, multivalue, nvalues, valuebytes, kv,
+ptr)`` / scan ``(key, keybytes, value, valuebytes, ptr)`` —
+``src/cmapreduce.h:24-148``).
+
+Keys/values cross the boundary as raw bytes, exactly like the
+reference's byte-packed pages: C-added pairs become BytesColumn rows;
+typed columns flatten to their little-endian bytes on the way out (a C
+struct view, ``oink/typedefs.h`` style).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.mapreduce import MapReduce
+from ..oink.script import OinkScript
+
+_handles: Dict[int, object] = {}
+_next_id = [1]
+
+MAPTASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                              ctypes.c_void_p)
+MAPFILE_FN = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_void_p, ctypes.c_void_p)
+REDUCE_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_int), ctypes.c_void_p,
+                             ctypes.c_void_p)
+SCAN_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
+                           ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                           ctypes.c_void_p)
+
+
+def _register(obj) -> int:
+    h = _next_id[0]
+    _next_id[0] += 1
+    _handles[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _handles[h]
+
+
+def _to_bytes(x) -> bytes:
+    """Any key/value → its raw bytes (C struct view of typed rows)."""
+    if isinstance(x, bytes):
+        return x
+    if isinstance(x, str):
+        return x.encode()
+    if isinstance(x, tuple):
+        return b"".join(_to_bytes(e) for e in x)
+    return np.asarray(x).tobytes()
+
+
+class _KVAccum:
+    """Batches per-pair C adds into one columnar add at flush (the
+    reference appends to a page; we append to a BytesColumn batch)."""
+
+    def __init__(self, kv):
+        self.kv = kv
+        self.keys: List[bytes] = []
+        self.values: List[bytes] = []
+
+    def add(self, key: bytes, value: bytes):
+        self.keys.append(key)
+        self.values.append(value)
+
+    def flush(self):
+        if self.keys:
+            self.kv.add_batch(self.keys, self.values)
+            self.keys, self.values = [], []
+
+
+# ---------------------------------------------------------------------------
+# entry points called from cmapreduce.c
+# ---------------------------------------------------------------------------
+
+def mr_create() -> int:
+    return _register(MapReduce())
+
+
+def mr_destroy(h: int):
+    _handles.pop(h, None)
+
+
+def mr_copy(h: int) -> int:
+    return _register(_get(h).copy())
+
+
+def mr_set(h: int, name: str, value: str) -> int:
+    mr = _get(h)
+    mr.set(**{name: value if name == "fpath" else int(value)})
+    return 0
+
+
+def kv_add(kvh: int, key: bytes, value: bytes):
+    _get(kvh).add(key, value)
+
+
+def mr_map(h: int, nmap: int, fnptr: int, appptr: int, addflag: int) -> int:
+    fn = MAPTASK_FN(fnptr)
+    mr = _get(h)
+
+    def wrapper(itask, kv, ptr):
+        acc = _KVAccum(kv)
+        kvh = _register(acc)
+        try:
+            fn(itask, kvh, appptr)
+            acc.flush()
+        finally:
+            _handles.pop(kvh, None)
+
+    return mr.map(nmap, wrapper, addflag=addflag)
+
+
+def mr_map_file_list(h: int, paths: List[bytes], fnptr: int, appptr: int,
+                     addflag: int) -> int:
+    fn = MAPFILE_FN(fnptr)
+    mr = _get(h)
+
+    def wrapper(itask, fname, kv, ptr):
+        acc = _KVAccum(kv)
+        kvh = _register(acc)
+        try:
+            fn(itask, fname.encode() if isinstance(fname, str) else fname,
+               kvh, appptr)
+            acc.flush()
+        finally:
+            _handles.pop(kvh, None)
+
+    return mr.map_files([p.decode() for p in paths], wrapper,
+                        addflag=addflag)
+
+
+def _call_reduce(fn, appptr, key, vals, kv):
+    kb = _to_bytes(key)
+    bvals = [_to_bytes(v) for v in vals]
+    mv = b"".join(bvals)
+    sizes = (ctypes.c_int * len(bvals))(*[len(b) for b in bvals])
+    acc = _KVAccum(kv)
+    kvh = _register(acc)
+    try:
+        buf = ctypes.create_string_buffer(mv, len(mv))
+        fn(kb, len(kb), buf, len(bvals), sizes, kvh, appptr)
+        acc.flush()
+    finally:
+        _handles.pop(kvh, None)
+
+
+def mr_reduce(h: int, fnptr: int, appptr: int) -> int:
+    fn = REDUCE_FN(fnptr)
+    mr = _get(h)
+    return mr.reduce(lambda k, vals, kv, ptr:
+                     _call_reduce(fn, appptr, k, vals, kv))
+
+
+def mr_compress(h: int, fnptr: int, appptr: int) -> int:
+    fn = REDUCE_FN(fnptr)
+    mr = _get(h)
+    return mr.compress(lambda k, vals, kv, ptr:
+                       _call_reduce(fn, appptr, k, vals, kv))
+
+
+def mr_scan_kv(h: int, fnptr: int, appptr: int) -> int:
+    fn = SCAN_FN(fnptr)
+
+    def wrapper(k, v, ptr):
+        kb, vb = _to_bytes(k), _to_bytes(v)
+        buf = ctypes.create_string_buffer(vb, len(vb))
+        fn(kb, len(kb), buf, len(vb), appptr)
+
+    return _get(h).scan_kv(wrapper)
+
+
+def mr_method_u64(h: int, name: str, *args) -> int:
+    """Run a no-callback MapReduce method returning a count: aggregate,
+    convert, collate, clone, collapse, close, open, gather, broadcast,
+    add, sort_keys, sort_values, sort_multivalues."""
+    mr = _get(h)
+    if name == "aggregate":
+        return mr.aggregate(None)
+    if name == "collate":
+        return mr.collate(None)
+    if name == "collapse":
+        return mr.collapse(args[0])
+    if name == "add":
+        return mr.add(_get(args[0]))
+    if name == "open":
+        mr.open(*args)
+        return 0
+    return getattr(mr, name)(*args)
+
+
+def mr_stats(h: int, which: str) -> int:
+    mr = _get(h)
+    if which == "kv":
+        return mr.kv_stats(0)[0] if mr.kv is not None else 0
+    return mr.kmv_stats(0)[0] if mr.kmv is not None else 0
+
+
+def mr_print_file(h: int, path: str, kflag: int, vflag: int) -> int:
+    return _get(h).print(kflag=kflag, vflag=vflag, file=path)
+
+
+# -- OINK script driver (reference oink/library.h mrmpi_open/...) ----------
+
+def oink_open(logfile: Optional[str]) -> int:
+    return _register(OinkScript(screen=None, logfile=logfile or None))
+
+
+def oink_file(h: int, path: str):
+    _get(h).run_file(path)
+
+
+def oink_command(h: int, line: str) -> Optional[str]:
+    return _get(h).one(line)
+
+
+def oink_close(h: int):
+    interp = _handles.pop(h, None)
+    if interp is not None:
+        interp.close()

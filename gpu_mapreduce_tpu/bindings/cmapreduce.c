@@ -1,0 +1,266 @@
+/* C ABI shim — embeds CPython and forwards every MR_* call to
+ * gpu_mapreduce_tpu.bindings.cbridge (the reference implements
+ * src/cmapreduce.cpp as a thin forwarding layer over the C++ class; this
+ * is the same layer over the Python engine).
+ *
+ * Handles: cbridge keeps an int→object table; the void* handles here are
+ * those ints cast to pointers.  C callback pointers travel to Python as
+ * integers and are re-entered through ctypes (cbridge.*_FN).
+ *
+ * Build (see bindings/__init__.py build_clib()):
+ *   gcc -shared -fPIC cmapreduce.c $(python3-config --includes) \
+ *       $(python3-config --ldflags --embed) -o libcmapreduce.so
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#include "cmapreduce.h"
+
+static PyObject *bridge = NULL;
+static char errbuf[4096];
+static int have_error = 0;
+
+static void capture_error(void) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  errbuf[0] = '\0';
+  if (value != NULL) {
+    PyObject *s = PyObject_Str(value);
+    if (s != NULL) {
+      const char *msg = PyUnicode_AsUTF8(s);
+      if (msg != NULL) strncpy(errbuf, msg, sizeof(errbuf) - 1);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  have_error = 1;
+}
+
+/* call bridge.<method>(args...) → new ref or NULL (error captured) */
+static PyObject *bridge_call(const char *method, const char *fmt, ...) {
+  if (bridge == NULL) {
+    strncpy(errbuf, "MR_init() not called", sizeof(errbuf) - 1);
+    have_error = 1;
+    return NULL;
+  }
+  have_error = 0;
+  PyGILState_STATE g = PyGILState_Ensure();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject *result = NULL;
+  if (args != NULL) {
+    PyObject *fn = PyObject_GetAttrString(bridge, method);
+    if (fn != NULL) {
+      result = PyObject_CallObject(fn, args);
+      Py_DECREF(fn);
+    }
+    Py_DECREF(args);
+  }
+  if (result == NULL) capture_error();
+  PyGILState_Release(g);
+  return result;
+}
+
+static uint64_t as_u64(PyObject *r) {
+  if (r == NULL) return 0;
+  uint64_t v = 0;
+  if (r != Py_None) v = (uint64_t)PyLong_AsUnsignedLongLong(r);
+  if (PyErr_Occurred()) {
+    capture_error();
+    v = 0;
+  }
+  Py_DECREF(r);
+  return v;
+}
+
+/* ------------------------------------------------------------------ */
+
+int MR_init(void) {
+  if (bridge != NULL) return 0;
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  PyGILState_STATE g = PyGILState_Ensure();
+  bridge = PyImport_ImportModule("gpu_mapreduce_tpu.bindings.cbridge");
+  if (bridge == NULL) capture_error();
+  PyGILState_Release(g);
+  return bridge == NULL ? -1 : 0;
+}
+
+void MR_finalize(void) {
+  Py_XDECREF(bridge);
+  bridge = NULL;
+  if (Py_IsInitialized()) Py_FinalizeEx();
+}
+
+const char *MR_last_error(void) { return have_error ? errbuf : NULL; }
+
+void *MR_create(void) {
+  return (void *)(intptr_t)as_u64(bridge_call("mr_create", "()"));
+}
+
+void MR_destroy(void *mr) {
+  Py_XDECREF(bridge_call("mr_destroy", "(n)", (Py_ssize_t)mr));
+}
+
+void *MR_copy(void *mr) {
+  return (void *)(intptr_t)as_u64(
+      bridge_call("mr_copy", "(n)", (Py_ssize_t)mr));
+}
+
+int MR_set(void *mr, const char *name, const char *value) {
+  PyObject *r = bridge_call("mr_set", "(nss)", (Py_ssize_t)mr, name, value);
+  if (r == NULL) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+void MR_kv_add(void *kv, const char *key, int keybytes, const char *value,
+               int valuebytes) {
+  Py_XDECREF(bridge_call("kv_add", "(ny#y#)", (Py_ssize_t)kv, key,
+                         (Py_ssize_t)keybytes, value,
+                         (Py_ssize_t)valuebytes));
+}
+
+uint64_t MR_map_add(void *mr, int nmap, void (*mymap)(int, void *, void *),
+                    void *ptr, int addflag) {
+  return as_u64(bridge_call("mr_map", "(ninni)", (Py_ssize_t)mr, nmap,
+                            (Py_ssize_t)(intptr_t)mymap,
+                            (Py_ssize_t)(intptr_t)ptr, addflag));
+}
+
+uint64_t MR_map(void *mr, int nmap, void (*mymap)(int, void *, void *),
+                void *ptr) {
+  return MR_map_add(mr, nmap, mymap, ptr, 0);
+}
+
+uint64_t MR_map_file_list(void *mr, int nstr, char **paths,
+                          void (*mymap)(int, char *, void *, void *),
+                          void *ptr) {
+  PyObject *list = PyList_New(nstr);
+  if (list == NULL) return 0;
+  for (int i = 0; i < nstr; i++)
+    PyList_SET_ITEM(list, i, PyBytes_FromString(paths[i]));
+  uint64_t n = as_u64(bridge_call("mr_map_file_list", "(nOnni)",
+                                  (Py_ssize_t)mr, list,
+                                  (Py_ssize_t)(intptr_t)mymap,
+                                  (Py_ssize_t)(intptr_t)ptr, 0));
+  Py_DECREF(list);
+  return n;
+}
+
+uint64_t MR_reduce(void *mr,
+                   void (*fn)(char *, int, char *, int, int *, void *,
+                              void *),
+                   void *ptr) {
+  return as_u64(bridge_call("mr_reduce", "(nnn)", (Py_ssize_t)mr,
+                            (Py_ssize_t)(intptr_t)fn,
+                            (Py_ssize_t)(intptr_t)ptr));
+}
+
+uint64_t MR_compress(void *mr,
+                     void (*fn)(char *, int, char *, int, int *, void *,
+                                void *),
+                     void *ptr) {
+  return as_u64(bridge_call("mr_compress", "(nnn)", (Py_ssize_t)mr,
+                            (Py_ssize_t)(intptr_t)fn,
+                            (Py_ssize_t)(intptr_t)ptr));
+}
+
+uint64_t MR_scan_kv(void *mr,
+                    void (*fn)(char *, int, char *, int, void *),
+                    void *ptr) {
+  return as_u64(bridge_call("mr_scan_kv", "(nnn)", (Py_ssize_t)mr,
+                            (Py_ssize_t)(intptr_t)fn,
+                            (Py_ssize_t)(intptr_t)ptr));
+}
+
+static uint64_t method0(void *mr, const char *name) {
+  return as_u64(bridge_call("mr_method_u64", "(ns)", (Py_ssize_t)mr, name));
+}
+
+uint64_t MR_aggregate(void *mr) { return method0(mr, "aggregate"); }
+uint64_t MR_convert(void *mr) { return method0(mr, "convert"); }
+uint64_t MR_collate(void *mr) { return method0(mr, "collate"); }
+uint64_t MR_clone(void *mr) { return method0(mr, "clone"); }
+
+uint64_t MR_collapse(void *mr, const char *key, int keybytes) {
+  return as_u64(bridge_call("mr_method_u64", "(nsy#)", (Py_ssize_t)mr,
+                            "collapse", key, (Py_ssize_t)keybytes));
+}
+
+uint64_t MR_gather(void *mr, int nprocs) {
+  return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
+                            "gather", nprocs));
+}
+
+uint64_t MR_broadcast(void *mr, int root) {
+  return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
+                            "broadcast", root));
+}
+
+uint64_t MR_add(void *mr, void *mr2) {
+  return as_u64(bridge_call("mr_method_u64", "(nsn)", (Py_ssize_t)mr,
+                            "add", (Py_ssize_t)mr2));
+}
+
+uint64_t MR_sort_keys_flag(void *mr, int flag) {
+  return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
+                            "sort_keys", flag));
+}
+
+uint64_t MR_sort_values_flag(void *mr, int flag) {
+  return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
+                            "sort_values", flag));
+}
+
+uint64_t MR_kv_stats(void *mr) {
+  return as_u64(bridge_call("mr_stats", "(ns)", (Py_ssize_t)mr, "kv"));
+}
+
+uint64_t MR_kmv_stats(void *mr) {
+  return as_u64(bridge_call("mr_stats", "(ns)", (Py_ssize_t)mr, "kmv"));
+}
+
+int MR_print_file(void *mr, const char *path, int kflag, int vflag) {
+  PyObject *r = bridge_call("mr_print_file", "(nsii)", (Py_ssize_t)mr, path,
+                            kflag, vflag);
+  if (r == NULL) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -- OINK script driver -------------------------------------------- */
+
+void *OINK_open(const char *logfile) {
+  PyObject *r;
+  if (logfile != NULL)
+    r = bridge_call("oink_open", "(s)", logfile);
+  else
+    r = bridge_call("oink_open", "(O)", Py_None);
+  return (void *)(intptr_t)as_u64(r);
+}
+
+int OINK_file(void *oink, const char *path) {
+  PyObject *r = bridge_call("oink_file", "(ns)", (Py_ssize_t)oink, path);
+  if (r == NULL) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int OINK_command(void *oink, const char *line) {
+  PyObject *r = bridge_call("oink_command", "(ns)", (Py_ssize_t)oink, line);
+  if (r == NULL) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+void OINK_close(void *oink) {
+  Py_XDECREF(bridge_call("oink_close", "(n)", (Py_ssize_t)oink));
+}

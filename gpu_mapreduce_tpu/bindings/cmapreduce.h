@@ -1,0 +1,91 @@
+/* C interface to the TPU MapReduce framework.
+ *
+ * The counterpart of the reference's src/cmapreduce.h: flat MR_*
+ * functions over opaque handles, with user callbacks as C function
+ * pointers carrying the same byte-oriented signatures.  The engine is
+ * the Python/JAX framework, embedded via CPython (cmapreduce.c); call
+ * MR_init() once before anything else and MR_finalize() at exit.
+ *
+ * Handles are returned by MR_create(); KV handles only exist inside
+ * callbacks (MR_kv_add them there, like the reference's KVptr).
+ */
+
+#ifndef GPUMR_CMAPREDUCE_H
+#define GPUMR_CMAPREDUCE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* runtime */
+int MR_init(void);                      /* 0 on success */
+void MR_finalize(void);
+const char *MR_last_error(void);        /* NULL if the last call succeeded */
+
+/* lifecycle */
+void *MR_create(void);
+void MR_destroy(void *mr);
+void *MR_copy(void *mr);
+int MR_set(void *mr, const char *name, const char *value);
+
+/* pair adds — valid only on the KV handle passed into a callback */
+void MR_kv_add(void *kv, const char *key, int keybytes,
+               const char *value, int valuebytes);
+
+/* map */
+uint64_t MR_map(void *mr, int nmap,
+                void (*mymap)(int itask, void *kv, void *ptr), void *ptr);
+uint64_t MR_map_add(void *mr, int nmap,
+                    void (*mymap)(int, void *, void *), void *ptr,
+                    int addflag);
+uint64_t MR_map_file_list(void *mr, int nstr, char **paths,
+                          void (*mymap)(int itask, char *fname, void *kv,
+                                        void *ptr),
+                          void *ptr);
+
+/* shuffle / grouping / reduce */
+uint64_t MR_aggregate(void *mr);
+uint64_t MR_convert(void *mr);
+uint64_t MR_collate(void *mr);
+uint64_t MR_clone(void *mr);
+uint64_t MR_collapse(void *mr, const char *key, int keybytes);
+uint64_t MR_gather(void *mr, int nprocs);
+uint64_t MR_broadcast(void *mr, int root);
+uint64_t MR_add(void *mr, void *mr2);
+uint64_t MR_reduce(void *mr,
+                   void (*myreduce)(char *key, int keybytes,
+                                    char *multivalue, int nvalues,
+                                    int *valuebytes, void *kv, void *ptr),
+                   void *ptr);
+uint64_t MR_compress(void *mr,
+                     void (*myreduce)(char *, int, char *, int, int *,
+                                      void *, void *),
+                     void *ptr);
+
+/* sorts (flag semantics of the reference: ±1..6) */
+uint64_t MR_sort_keys_flag(void *mr, int flag);
+uint64_t MR_sort_values_flag(void *mr, int flag);
+
+/* read-only */
+uint64_t MR_scan_kv(void *mr,
+                    void (*myscan)(char *key, int keybytes, char *value,
+                                   int valuebytes, void *ptr),
+                    void *ptr);
+uint64_t MR_kv_stats(void *mr);
+uint64_t MR_kmv_stats(void *mr);
+int MR_print_file(void *mr, const char *path, int kflag, int vflag);
+
+/* OINK script driver (reference oink/library.h mrmpi_open/file/command/
+ * close) */
+void *OINK_open(const char *logfile);   /* logfile NULL → no log */
+int OINK_file(void *oink, const char *path);
+int OINK_command(void *oink, const char *line);
+void OINK_close(void *oink);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* GPUMR_CMAPREDUCE_H */

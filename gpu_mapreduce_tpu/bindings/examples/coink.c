@@ -1,0 +1,26 @@
+/* coink — drive the OINK script interpreter from C (the counterpart of
+ * the reference's oink/library.h mrmpi_open/_file/_command/_close).
+ *
+ * Usage: coink script.oink [logfile]
+ */
+
+#include <stdio.h>
+
+#include "../cmapreduce.h"
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s script.oink [logfile]\n", argv[0]);
+    return 1;
+  }
+  if (MR_init() != 0) {
+    fprintf(stderr, "MR_init failed: %s\n", MR_last_error());
+    return 1;
+  }
+  void *oink = OINK_open(argc > 2 ? argv[2] : NULL);
+  int rc = OINK_file(oink, argv[1]);
+  if (rc != 0) fprintf(stderr, "script error: %s\n", MR_last_error());
+  OINK_close(oink);
+  MR_finalize();
+  return rc == 0 ? 0 : 1;
+}

@@ -143,14 +143,19 @@ def parse_table(buf: bytes, dtypes) -> List[np.ndarray]:
         cap = -n
 
 
-def find_hrefs(buf: bytes) -> Tuple[np.ndarray, np.ndarray]:
+def find_hrefs(buf) -> Tuple[np.ndarray, np.ndarray]:
     """URL (starts, lens) of every `<a href="..."` match — the host
-    equivalent of the Pallas mark/extract pipeline."""
+    equivalent of the Pallas mark/extract pipeline.  ``buf``: bytes or a
+    uint8 ndarray (passed zero-copy)."""
+    if isinstance(buf, np.ndarray):
+        ptr = _arr(np.ascontiguousarray(buf, np.uint8), ctypes.c_uint8)
+    else:
+        ptr = _u8(buf)
     cap = max(16, len(buf) // 64)
     while True:
         starts = np.empty(cap, np.int64)
         lens = np.empty(cap, np.int64)
-        n = _lib.mr_find_hrefs(_u8(buf), len(buf),
+        n = _lib.mr_find_hrefs(ptr, len(buf),
                                _arr(starts, ctypes.c_int64),
                                _arr(lens, ctypes.c_int64), cap)
         if n >= 0:

@@ -125,9 +125,12 @@ int64_t mr_parse_table(const uint8_t *buf, int64_t len, int64_t ncols,
     int64_t col = ntok % ncols, row = ntok / ncols;
     if (row < maxrows) {
       if (colspec[col] == 0) {
-        if (i - s == 0 || i - s > 20) return -1;  // u64 max is 20 digits
+        int64_t p = s;
+        if (p < i && buf[p] == '+') p++;          // fallback accepts '+5'
+        while (p < i - 1 && buf[p] == '0') p++;   // and zero-padding
+        if (p >= i || i - p > 20) return -1;      // u64 max is 20 digits
         uint64_t v = 0;
-        for (int64_t p = s; p < i; p++) {
+        for (; p < i; p++) {
           uint8_t c = buf[p];
           if (c < '0' || c > '9') return -1;
           uint64_t next = v * 10u + (c - '0');
@@ -139,14 +142,23 @@ int64_t mr_parse_table(const uint8_t *buf, int64_t len, int64_t ncols,
         char tmp[64];
         if (i - s == 0 || i - s >= 63) return -1;  // no f64 literal needs more
         int64_t tl = i - s;
-        // decimal literals only — strtod alone would accept hex/inf/nan
-        // that the numpy fallback rejects
-        for (int64_t p = 0; p < tl; p++) {
-          char c = buf[s + p];
-          if (!((c >= '0' && c <= '9') || c == '.' || c == '+' ||
-                c == '-' || c == 'e' || c == 'E'))
-            return -1;
-        }
+        // decimal literals plus inf/nan (which the numpy fallback also
+        // accepts) — but not strtod's hex or partial-token forms
+        int64_t body = (buf[s] == '+' || buf[s] == '-') ? 1 : 0;
+        int is_special = 0;
+        if (tl - body == 3 &&
+            (memcmp(buf + s + body, "inf", 3) == 0 ||
+             memcmp(buf + s + body, "nan", 3) == 0))
+          is_special = 1;
+        if (tl - body == 8 && memcmp(buf + s + body, "infinity", 8) == 0)
+          is_special = 1;
+        if (!is_special)
+          for (int64_t p = 0; p < tl; p++) {
+            char c = buf[s + p];
+            if (!((c >= '0' && c <= '9') || c == '.' || c == '+' ||
+                  c == '-' || c == 'e' || c == 'E'))
+              return -1;
+          }
         memcpy(tmp, buf + s, tl);
         tmp[tl] = '\0';
         char *endp = nullptr;
@@ -181,7 +193,8 @@ int64_t mr_find_hrefs(const uint8_t *buf, int64_t len, int64_t *starts,
     if (e >= len) break;
     if (n < max) { starts[n] = s; lens[n] = e - s; }
     n++;
-    i = e;  // resume after the URL (matches never overlap)
+    // no skip: the device mark kernel flags every pattern position, and
+    // a match can legally start inside a prior URL span
   }
   return n <= max ? n : -n;
 }

@@ -1,0 +1,74 @@
+"""C ABI tests — compile the bindings examples with the system compiler
+and run them as subprocesses against oracles (the reference's C interface
+is exercised by examples/cwordfreq.c; ours the same way)."""
+
+import collections
+import os
+import random
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from gpu_mapreduce_tpu.bindings import build_example
+
+pytestmark = pytest.mark.skipif(shutil.which("gcc") is None,
+                                reason="no C compiler")
+
+
+def _run(exe, *args, cwd=None):
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return subprocess.run([exe, *args], capture_output=True, text=True,
+                          timeout=300, env=env, cwd=cwd)
+
+
+@pytest.fixture(scope="module")
+def cwordfreq(tmp_path_factory):
+    out = tmp_path_factory.mktemp("bin") / "cwordfreq"
+    return build_example("cwordfreq", out=str(out))
+
+
+@pytest.fixture(scope="module")
+def coink(tmp_path_factory):
+    out = tmp_path_factory.mktemp("bin") / "coink"
+    return build_example("coink", out=str(out))
+
+
+def test_cwordfreq_matches_counter(cwordfreq, tmp_path):
+    random.seed(9)
+    vocab = ["ant", "bee", "cat", "dog", "eel", "fox", "gnu"]
+    words = random.choices(vocab, [30, 25, 18, 11, 8, 5, 3], k=3000)
+    f1, f2 = tmp_path / "a.txt", tmp_path / "b.txt"
+    f1.write_text(" ".join(words[:1500]))
+    f2.write_text(" ".join(words[1500:]))
+    r = _run(cwordfreq, str(f1), str(f2))
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = r.stdout.strip().splitlines()
+    oracle = collections.Counter(words)
+    assert lines[0] == f"3000 total words, {len(oracle)} unique words"
+    top = [(ln.split()[1], int(ln.split()[0])) for ln in lines[1:6]]
+    assert top == oracle.most_common(5)
+
+
+def test_coink_runs_script(coink, tmp_path):
+    words = tmp_path / "w.txt"
+    words.write_text("red blue red green red blue " * 10)
+    script = tmp_path / "in.c_oink"
+    script.write_text(f"variable files index {words}\n"
+                      f"wordfreq 2 -i v_files\n"
+                      f'print "driven from C"\n')
+    log = tmp_path / "log.oink"
+    r = _run(coink, str(script), str(log), cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "1 files, 60 words, 3 unique" in r.stdout
+    assert "driven from C" in log.read_text()
+
+
+def test_coink_script_error_reported(coink, tmp_path):
+    script = tmp_path / "bad.oink"
+    script.write_text("frobnicate 1\n")
+    r = _run(coink, str(script))
+    assert r.returncode == 1
+    assert "Unknown command" in r.stderr

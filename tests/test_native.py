@@ -96,8 +96,31 @@ def test_find_hrefs_matches_regex():
     html = b"<html>" + b"".join(parts) + b'<a href="noquote'
     s, l = native.find_hrefs(html)
     got = [html[a:a + b] for a, b in zip(s, l)]
-    oracle = re.findall(rb'<a href="([^"]*)"', html)
+    # lookahead regex: every match position, like the device mark kernel
+    oracle = [m.group(1) for m in
+              re.finditer(rb'(?=<a href="([^"]*)")', html)]
     assert got == oracle == urls
+
+
+def test_find_hrefs_overlapping_matches():
+    # a pattern occurrence *inside* a prior URL span must still match
+    # (device mark kernel marks every position)
+    html = b'<a href="aaa<a href="bar">x</a>'
+    s, l = native.find_hrefs(html)
+    got = [html[a:a + b] for a, b in zip(s, l)]
+    oracle = [m.group(1) for m in
+              re.finditer(rb'(?=<a href="([^"]*)")', html)]
+    assert got == oracle == [b'aaa<a href=', b'bar']
+
+
+def test_parse_table_inf_nan_plus_like_fallback():
+    u, f = native.parse_table(b"+5 inf\n007 -nan\n1 -infinity\n",
+                              (np.uint64, np.float64))
+    assert u.tolist() == [5, 7, 1]
+    assert f[0] == np.inf and np.isnan(f[1]) and f[2] == -np.inf
+    # zero-padded beyond 20 chars still parses (fallback does too)
+    u2, = native.parse_table(b"0000000000000000000000042\n", (np.uint64,))
+    assert u2.tolist() == [42]
 
 
 def test_kernels_parse_cols_native_path(tmp_path):
