@@ -27,13 +27,15 @@ _jax.config.update("jax_enable_x64", True)
 
 from .core.mapreduce import MapReduce, SerialBackend
 from .core.dataset import KeyValue, KeyMultiValue
-from .core.frame import KVFrame, KMVFrame
+from .core.frame import (BlockedMultivalue, KMVFrame, KVFrame,
+                         iter_blocks)
 from .core.column import BytesColumn, DenseColumn, as_column
 from .core.runtime import MRError, Settings, global_counters
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "BlockedMultivalue", "iter_blocks",
     "MapReduce", "SerialBackend", "KeyValue", "KeyMultiValue",
     "KVFrame", "KMVFrame", "BytesColumn", "DenseColumn", "as_column",
     "MRError", "Settings", "global_counters",

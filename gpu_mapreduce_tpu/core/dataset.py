@@ -65,6 +65,23 @@ def _col_to_npz(col: Column, prefix: str, out: dict):
         out[prefix + "_arr"] = np.asarray(col.data)
 
 
+def _write_spill(settings: Settings, counters: Counters, name: str,
+                 fileid: int, seq: int, payload: dict, nbytes: int) -> str:
+    """Shared spill writer: fpath dir + mrtpu.<name>.<id>.<seq>.npz naming
+    + write-counter accounting (reference file naming
+    src/mapreduce.cpp:3187-3205) — one implementation for KV and KMV."""
+    os.makedirs(settings.fpath, exist_ok=True)
+    path = os.path.join(settings.fpath,
+                        f"mrtpu.{name}.{fileid}.{seq}.npz")
+    np.savez(path, **payload)
+    counters.wsize += nbytes
+    return path
+
+
+def _spill_budget(settings: Settings) -> int:
+    return settings.maxpage * settings.memsize * (1 << 20)
+
+
 def _col_from_npz(z, prefix: str) -> Column:
     if prefix + "_obj" in z:
         return BytesColumn(z[prefix + "_obj"])
@@ -156,7 +173,7 @@ class KeyValue:
         return f.n if isinstance(f, _Spilled) else len(f)  # len covers ShardedKV too
 
     def _push_frame(self, fr: KVFrame):
-        budget = self.settings.maxpage * self.settings.memsize * (1 << 20)
+        budget = _spill_budget(self.settings)
         if (self.settings.outofcore == 1 and budget
                 and self._resident_bytes() + fr.nbytes() > budget):
             self._spill(fr)
@@ -168,16 +185,12 @@ class KeyValue:
         return sum(f.nbytes() for f in self._frames if isinstance(f, KVFrame))
 
     def _spill(self, fr: KVFrame):
-        os.makedirs(self.settings.fpath, exist_ok=True)
-        path = os.path.join(
-            self.settings.fpath,
-            f"mrtpu.{self.name}.{self.fileid}.{len(self._frames)}.npz")
         payload: dict = {}
         _col_to_npz(fr.key.to_host(), "k", payload)
         _col_to_npz(fr.value.to_host(), "v", payload)
-        np.savez(path, **payload)
         nb = fr.nbytes()
-        self.counters.wsize += nb
+        path = _write_spill(self.settings, self.counters, self.name,
+                            self.fileid, len(self._frames), payload, nb)
         self._frames.append(_Spilled(path, len(fr), nb))
 
     # -- read protocol -----------------------------------------------------
@@ -223,23 +236,75 @@ class KeyValue:
         self.nkv = 0
 
 
+class _SpilledKMV:
+    """A KMV frame parked in an .npz spill file (the grouped counterpart
+    of _Spilled; the reference's extended-KMV pages also round-trip
+    through fpath files, src/keymultivalue.cpp:1219-1350)."""
+
+    __slots__ = ("path", "n", "nvalues_total", "bytes_")
+
+    def __init__(self, path: str, n: int, nvalues_total: int, bytes_: int):
+        self.path = path
+        self.n = n
+        self.nvalues_total = nvalues_total
+        self.bytes_ = bytes_
+
+    def load(self, counters: Counters) -> KMVFrame:
+        with np.load(self.path, allow_pickle=True) as z:
+            key = _col_from_npz(z, "k")
+            values = _col_from_npz(z, "v")
+            nvalues = z["nv"]
+            offsets = z["off"]
+        counters.rsize += self.bytes_
+        return KMVFrame(key, nvalues, offsets, values)
+
+
 class KeyMultiValue:
-    """Grouped dataset: list of KMVFrames (one per source frame batch)."""
+    """Grouped dataset: list of KMVFrames (one per source frame batch),
+    spilling to fpath .npz under ``outofcore=1`` like KeyValue."""
 
     def __init__(self, settings: Settings, error: Error, counters: Counters):
         self.settings = settings
         self.error = error
         self.counters = counters
-        self._frames: List[KMVFrame] = []
+        self.fileid = _next_file_id()
+        self._frames: List[object] = []     # KMVFrame | _SpilledKMV | sharded
         self.nkmv = 0
         self.nvalues = 0
 
-    def push(self, fr: KMVFrame):
-        self._frames.append(fr)
-        self.counters.mem(fr.nbytes())
+    def push(self, fr):
+        budget = _spill_budget(self.settings)
+        if (self.settings.outofcore == 1 and budget
+                and isinstance(fr, KMVFrame)
+                and self._resident_bytes() + fr.nbytes() > budget):
+            # split on group boundaries first so each spilled piece fits
+            # the budget — reduce()/scan then stream piece-at-a-time in
+            # bounded memory instead of reloading one giant frame (the
+            # point of the reference's paged KMV, doc/Technical.txt:200-214)
+            for piece in _split_kmv_to_budget(fr, self.settings):
+                self._spill(piece)
+        else:
+            self._frames.append(fr)
+            self.counters.mem(fr.nbytes())
+
+    def _resident_bytes(self) -> int:
+        return sum(f.nbytes() for f in self._frames
+                   if isinstance(f, KMVFrame))
+
+    def _spill(self, fr: KMVFrame):
+        payload: dict = {"nv": np.asarray(fr.nvalues),
+                         "off": np.asarray(fr.offsets)}
+        _col_to_npz(fr.key.to_host(), "k", payload)
+        _col_to_npz(fr.values.to_host(), "v", payload)
+        nb = fr.nbytes()
+        path = _write_spill(self.settings, self.counters, "kmv",
+                            self.fileid, len(self._frames), payload, nb)
+        self._frames.append(_SpilledKMV(path, len(fr), fr.nvalues_total,
+                                        nb))
 
     def complete(self):
-        self.nkmv = sum(len(f) for f in self._frames)
+        self.nkmv = sum(f.n if isinstance(f, _SpilledKMV) else len(f)
+                        for f in self._frames)
         self.nvalues = sum(f.nvalues_total for f in self._frames)
         return self.nkmv
 
@@ -248,16 +313,19 @@ class KeyMultiValue:
         return len(self._frames)
 
     def frames(self) -> Iterator[KMVFrame]:
-        yield from self._frames
+        for f in self._frames:
+            yield f.load(self.counters) if isinstance(f, _SpilledKMV) else f
 
     def one_frame(self) -> KMVFrame:
-        frames = self._frames
+        frames = list(self.frames())
         if len(frames) == 1:
             return frames[0]
         if not frames:
             return KMVFrame(DenseColumn(np.zeros(0, np.uint64)),
                             np.zeros(0, np.int64), np.zeros(1, np.int64),
                             DenseColumn(np.zeros(0, np.uint64)))
+        frames = [f if isinstance(f, KMVFrame) else f.to_host()
+                  for f in frames]
         key = concat([f.key for f in frames])
         values = concat([f.values for f in frames])
         nvalues = np.concatenate([f.nvalues for f in frames])
@@ -265,11 +333,18 @@ class KeyMultiValue:
         return KMVFrame(key, nvalues, offsets, values)
 
     def nbytes(self) -> int:
-        return sum(f.nbytes() for f in self._frames)
+        return sum(f.bytes_ if isinstance(f, _SpilledKMV) else f.nbytes()
+                   for f in self._frames)
 
     def free(self):
         for f in self._frames:
-            self.counters.mem(-f.nbytes())
+            if isinstance(f, _SpilledKMV):
+                try:
+                    os.remove(f.path)
+                except OSError:
+                    pass
+            else:
+                self.counters.mem(-f.nbytes())
         self._frames = []
         self.nkmv = 0
         self.nvalues = 0
@@ -298,6 +373,34 @@ def _merge_frames(frames: Sequence[KVFrame]) -> KVFrame:
         return frames[0]
     return KVFrame(concat([f.key for f in frames]),
                    concat([f.value for f in frames]))
+
+
+def _split_kmv_to_budget(fr: KMVFrame, settings: Settings) -> List[KMVFrame]:
+    """Split a KMV frame into ≤ memsize pieces on group boundaries.  A
+    single group larger than the budget stays one piece — that is the
+    multi-block case BlockedMultivalue streams (reference "extended" KMV,
+    src/keymultivalue.cpp:974-999)."""
+    limit = settings.memsize * (1 << 20)
+    if len(fr) == 0 or fr.nbytes() <= limit:
+        return [fr]
+    row_bytes = fr.nbytes() / max(1, fr.nvalues_total)
+    rows_per = max(1, int(limit / row_bytes))
+    offsets = np.asarray(fr.offsets)
+    pieces: List[KMVFrame] = []
+    g = 0
+    while g < len(fr):
+        start_row = int(offsets[g])
+        # furthest group whose end stays within rows_per of start_row
+        h = int(np.searchsorted(offsets, start_row + rows_per,
+                                side="right")) - 1
+        h = max(h, g + 1)          # always advance ≥ 1 group
+        h = min(h, len(fr))
+        sub_off = (offsets[g:h + 1] - start_row).astype(np.int64)
+        pieces.append(KMVFrame(
+            fr.key.slice(g, h), np.asarray(fr.nvalues[g:h]), sub_off,
+            fr.values.slice(start_row, int(offsets[h]))))
+        g = h
+    return pieces
 
 
 def _split_to_budget(fr: KVFrame, settings: Settings) -> List[KVFrame]:

@@ -130,6 +130,44 @@ class KMVFrame:
                 f"key={self.key!r}, values={self.values!r})")
 
 
+class BlockedMultivalue:
+    """The reference's "extended" multi-page KMV handle: a reduce callback
+    receives this instead of a value list when a group exceeds
+    ``block_rows`` (the reference signals with ``nvalues==0`` and the
+    callback pulls pages via ``multivalue_blocks()``/``multivalue_block()``,
+    src/mapreduce.cpp:1874-1925).  Iterating yields one value-list block
+    at a time, so a group of any size streams through bounded memory."""
+
+    __slots__ = ("_frame", "_i", "block_rows")
+
+    def __init__(self, frame: "KMVFrame", i: int, block_rows: int):
+        self._frame = frame
+        self._i = i
+        self.block_rows = block_rows
+
+    @property
+    def nvalues_total(self) -> int:
+        return int(self._frame.nvalues[self._i])
+
+    def __len__(self) -> int:
+        return self.nvalues_total
+
+    def __iter__(self):
+        for col in self._frame.blocks_of(self._i, self.block_rows):
+            yield col.tolist()
+
+
+def iter_blocks(multivalue) -> Iterator[list]:
+    """Normalise a reduce callback's multivalue: yields value-list blocks
+    whether it got a plain list or a :class:`BlockedMultivalue` — the
+    CHECK_FOR_BLOCKS/BEGIN_BLOCK_LOOP/END_BLOCK_LOOP idiom of
+    ``oink/blockmacros.h`` as one generator."""
+    if isinstance(multivalue, BlockedMultivalue):
+        yield from multivalue
+    else:
+        yield multivalue
+
+
 def empty_kv() -> KVFrame:
     return KVFrame(DenseColumn(np.zeros(0, np.uint64)),
                    DenseColumn(np.zeros(0, np.uint64)))

@@ -34,7 +34,7 @@ from ..ops.sort import argsort_column
 from ..utils.io import file_chunks, findfiles
 from .column import BytesColumn, Column, DenseColumn, as_column, concat
 from .dataset import KeyMultiValue, KeyValue
-from .frame import KMVFrame, KVFrame
+from .frame import BlockedMultivalue, KMVFrame, KVFrame
 from .runtime import Counters, Error, MRError, Settings, Timer, global_counters
 
 
@@ -342,19 +342,29 @@ class MapReduce:
     # ------------------------------------------------------------------
     # reduce family
     # ------------------------------------------------------------------
-    def reduce(self, func: Callable, ptr=None, batch: bool = False) -> int:
+    def reduce(self, func: Callable, ptr=None, batch: bool = False,
+               block_rows: Optional[int] = None) -> int:
         """Callback per KMV group → new KV (reference
         src/mapreduce.cpp:1769-1867; SURVEY.md §3.4).
 
         host path: func(key, values_list, kv, ptr) per group;
         batch path: func(kmv_frame, kv, ptr) per KMVFrame — the vectorised
-        tier that keeps reduction on device (segment ops)."""
+        tier that keeps reduction on device (segment ops).
+
+        ``block_rows``: groups larger than this receive a
+        :class:`~.frame.BlockedMultivalue` instead of a list — the
+        reference's multi-page "extended" KMV (nvalues==0 signal +
+        multivalue_blocks(), src/mapreduce.cpp:1874-1925).  Callbacks use
+        ``iter_blocks(mv)`` to handle both uniformly; setting it tiny is
+        the ONEMAX stress hook (src/keymultivalue.cpp:43-45)."""
         t = Timer()
         kmv = self._require_kmv("reduce")
         kv = self._new_kv()
         for fr in kmv.frames():
             if batch:
                 func(fr, kv, ptr)
+            elif block_rows is not None:
+                self._reduce_blocked(fr, func, kv, ptr, block_rows)
             else:
                 for k, vals in fr.groups():
                     func(k, vals, kv, ptr)
@@ -362,6 +372,17 @@ class MapReduce:
         self.kmv = None
         self.kv = kv
         return self._finish_kv("reduce")
+
+    @staticmethod
+    def _reduce_blocked(fr, func, kv, ptr, block_rows: int):
+        if not isinstance(fr, KMVFrame):
+            fr = fr.to_host()
+        keys = fr.key.tolist()
+        for i, k in enumerate(keys):
+            if int(fr.nvalues[i]) > block_rows:
+                func(k, BlockedMultivalue(fr, i, block_rows), kv, ptr)
+            else:
+                func(k, fr.group_values(i).tolist(), kv, ptr)
 
     def compress(self, func: Callable, ptr=None, batch: bool = False) -> int:
         """Local convert + reduce, KV→KV — the combiner (reference
@@ -384,13 +405,19 @@ class MapReduce:
                     func(k, v, ptr)
         return int(self.backend.allreduce_sum(kv.nkv))
 
-    def scan_kmv(self, func: Callable, ptr=None, batch: bool = False) -> int:
+    def scan_kmv(self, func: Callable, ptr=None, batch: bool = False,
+                 block_rows: Optional[int] = None) -> int:
         """Read-only iteration over KMV groups (reference
-        src/mapreduce.cpp:2000-2065)."""
+        src/mapreduce.cpp:2000-2065).  ``block_rows`` as in :meth:`reduce`
+        (the reference's scan shares the multi-block machinery)."""
         kmv = self._require_kmv("scan")
         for fr in kmv.frames():
             if batch:
                 func(fr, ptr)
+            elif block_rows is not None:
+                self._reduce_blocked(
+                    fr, lambda k, mv, _kv, p: func(k, mv, p), None, ptr,
+                    block_rows)
             else:
                 for k, vals in fr.groups():
                     func(k, vals, ptr)

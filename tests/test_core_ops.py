@@ -239,3 +239,85 @@ def test_tuple_struct_keys():
     mr.compress(count)
     got = dict(kv_pairs(mr))
     assert got == {(1, 2): 2, (2, 3): 1, (3, 1): 1}
+
+
+# ---------------------------------------------------------------------------
+# multi-block ("extended") KMV + KMV spill (reference multivalue_blocks
+# API src/mapreduce.cpp:1874-1925; ONEMAX stress src/keymultivalue.cpp:43-45)
+# ---------------------------------------------------------------------------
+
+def test_reduce_blocked_matches_plain():
+    import numpy as np
+    from gpu_mapreduce_tpu import MapReduce, iter_blocks
+
+    def build():
+        mr = MapReduce()
+        k = np.repeat(np.arange(5, dtype=np.uint64), [1, 7, 50, 3, 200])
+        v = np.arange(len(k), dtype=np.uint64)
+        mr.map(1, lambda i, kv, p: kv.add_batch(k, v))
+        mr.convert()
+        return mr
+
+    def summer(key, mv, kv, ptr):
+        total = nv = 0
+        for block in iter_blocks(mv):
+            total += sum(block)
+            nv += len(block)
+        kv.add(key, (total, nv))
+
+    plain, blocked = {}, {}
+    mr = build()
+    mr.reduce(summer, batch=False)
+    mr.scan_kv(lambda k, v, p: plain.__setitem__(int(k), tuple(v)))
+    mr2 = build()
+    mr2.reduce(summer, block_rows=8)      # the ONEMAX shrink trick
+    mr2.scan_kv(lambda k, v, p: blocked.__setitem__(int(k), tuple(v)))
+    assert plain == blocked
+    assert blocked[4][1] == 200           # big group streamed in 25 blocks
+
+    # a blocked callback saw BlockedMultivalue for big groups only
+    kinds = {}
+    mr3 = build()
+    mr3.scan_kmv(lambda k, mv, p: kinds.__setitem__(
+        int(k), type(mv).__name__), block_rows=8)
+    assert kinds[0] == "list" and kinds[4] == "BlockedMultivalue"
+
+
+def test_kmv_outofcore_spill(tmp_path):
+    import glob
+    import numpy as np
+    from gpu_mapreduce_tpu import MapReduce
+    from gpu_mapreduce_tpu.oink.kernels import count
+
+    mr = MapReduce(outofcore=1, maxpage=1, memsize=1, fpath=str(tmp_path))
+    k = (np.arange(1_200_000, dtype=np.uint64) % 1000)
+    mr.map(1, lambda i, kv, p: kv.add_batch(k, k))
+    mr.convert()
+    spills = glob.glob(str(tmp_path / "mrtpu.kmv.*.npz"))
+    assert spills, "expected KMV spill files"
+    n = mr.reduce(count, batch=True)
+    assert n == 1000
+    got = {}
+    mr.scan_kv(lambda key, v, p: got.__setitem__(int(key), int(v)))
+    assert got == {i: 1200 for i in range(1000)}
+    mr.kv.free()
+    assert not glob.glob(str(tmp_path / "mrtpu.kmv.*.npz"))
+
+
+def test_kmv_spill_splits_to_budget(tmp_path):
+    import glob
+    import numpy as np
+    from gpu_mapreduce_tpu import MapReduce
+    from gpu_mapreduce_tpu.oink.kernels import count
+
+    mr = MapReduce(outofcore=1, maxpage=1, memsize=1, fpath=str(tmp_path))
+    k = (np.arange(2_000_000, dtype=np.uint64) % 4000)
+    mr.map(1, lambda i, kv, p: kv.add_batch(k, k))
+    mr.convert()
+    spills = glob.glob(str(tmp_path / "mrtpu.kmv.*.npz"))
+    # ~30 MB of groups under a 1 MB budget must become many pieces, each
+    # within ~2x of the budget (group-boundary rounding)
+    assert len(spills) > 5
+    import os
+    assert all(os.path.getsize(p) < 3 * (1 << 20) for p in spills)
+    assert mr.reduce(count, batch=True) == 4000
