@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""IntCount — u32-key counting over binary files (the counterpart of the
+reference's cpu/IntCount.cpp shuffle/group stress benchmark).
+
+Usage: python examples/intcount.py file1 [file2 ...]
+"""
+
+import sys
+
+from gpu_mapreduce_tpu.apps.intcount import intcount
+
+
+def main(argv):
+    if len(argv) < 2:
+        raise SystemExit(f"usage: {argv[0]} file1 [file2 ...]")
+    nints, nunique, top = intcount(argv[1:], ntop=10)
+    print(f"{nints} ints, {nunique} unique")
+    for k, n in top:
+        print(n, k)
+
+
+if __name__ == "__main__":
+    main(sys.argv)

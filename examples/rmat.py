@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""RMAT generation + degree stats via the Python command API — the
+counterpart of the reference's examples/rmat.py / examples/rmat.cpp.
+
+Usage: python examples/rmat.py N Nz a b c d frac seed [outfile]
+e.g.:  python examples/rmat.py 16 8 0.25 0.25 0.25 0.25 0.0 12345
+"""
+
+import sys
+
+from gpu_mapreduce_tpu.oink import ObjectManager, run_command
+
+
+def main(argv):
+    if len(argv) < 9:
+        raise SystemExit(f"usage: {argv[0]} N Nz a b c d frac seed "
+                         f"[outfile]")
+    obj = ObjectManager()
+    outputs = [(argv[9], "mre")] if len(argv) > 9 else [(None, "mre")]
+    run_command("rmat", argv[1:9], obj=obj, outputs=outputs)
+    run_command("degree_stats", ["0"], obj=obj, inputs=["mre"])
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Word frequency via the Python API — the counterpart of the reference's
+examples/wordfreq.py (ctypes wrapper script) and examples/wordfreq.cpp.
+
+Usage: python examples/wordfreq.py file1 [file2 ...]
+"""
+
+import sys
+
+from gpu_mapreduce_tpu.apps.wordfreq import wordfreq
+
+
+def main(argv):
+    if len(argv) < 2:
+        raise SystemExit(f"usage: {argv[0]} file1 [file2 ...]")
+    nwords, nunique, top = wordfreq(argv[1:], ntop=10, quiet=False)
+    print(f"{nwords} total words, {nunique} unique words")
+    for word, n in top:
+        print(n, word.decode(errors="replace"))
+
+
+if __name__ == "__main__":
+    main(sys.argv)

@@ -1,0 +1,21 @@
+"""Shared app helpers."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+
+def top_n(mr, ntop: int) -> List[Tuple[object, object]]:
+    """Gather to one shard, sort by value descending, take the first ntop
+    (key, value) pairs — the reference's top-N tail (gather(1) +
+    sort_values + bounded print, examples/wordfreq.cpp:100-116)."""
+    mr.gather(1)
+    mr.sort_values(-1)
+    top: List[Tuple[object, object]] = []
+
+    def take(k, v, ptr):
+        if len(top) < ntop:
+            top.append((k, v))
+
+    mr.scan_kv(take)
+    return top

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..core.column import BytesColumn, DenseColumn
 from ..core.mapreduce import MapReduce
+from .common import top_n
 from ..utils.io import read_words
 
 
@@ -44,17 +45,7 @@ def wordfreq(files: Sequence[str], ntop: int = 10, comm=None,
     nwords = mr.map_files(list(files), _fileread)
     mr.collate()
     nunique = mr.reduce(_sum)
-    # top-N: sort by descending count (reference: sort_values(&ncompare) then
-    # gather(1) + sort + print, examples/wordfreq.cpp:100-116)
-    mr.gather(1)
-    mr.sort_values(-1)
-    top: List[Tuple[bytes, int]] = []
-
-    def take(k, v, ptr):
-        if len(top) < ntop:
-            top.append((k, v))
-
-    mr.scan_kv(take)
+    top = [(k, int(v)) for k, v in top_n(mr, ntop)]
     if not quiet:
         print(f"{nwords} total words, {nunique} unique words")
         for w, c in top:

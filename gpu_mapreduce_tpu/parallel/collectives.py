@@ -10,15 +10,16 @@
 
 from __future__ import annotations
 
+import functools
+
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.frame import KVFrame
-from .mesh import AXIS, mesh_axis_size, row_sharding
-from .sharded import ShardedKV, round_cap, shard_frame
+from .mesh import AXIS, row_sharding
+from .sharded import ShardedKV, shard_frame
 from .shuffle import exchange, _replace_kv_frames
 
 
@@ -36,22 +37,13 @@ def gather_kv(backend, mr, nprocs: int):
     if skv is None:
         return  # host-resident data is already "gathered"
     n = min(nprocs, backend.nprocs)
-
-    def dest_of(keys):
-        me = lax.axis_index(AXIS)
-        d = (me % n).astype(jnp.int32)
-        return jnp.full(keys.shape[0], d, jnp.int32)
-
-    out = exchange(skv, dest_of, transport=mr.settings.all2all,
+    out = exchange(skv, ("fixed_mod", n), transport=mr.settings.all2all,
                    counters=mr.counters)
     _replace_kv_frames(mr.kv, out)
 
 
-def broadcast_kv(backend, mr, root: int):
-    skv = _ensure_sharded(backend, mr)
-    if skv is None:
-        return
-    mesh = skv.mesh
+@functools.lru_cache(maxsize=None)
+def _broadcast_jit(mesh, root: int):
     spec = P(AXIS)
 
     @jax.jit
@@ -63,7 +55,15 @@ def broadcast_kv(backend, mr, root: int):
         return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec),
                              out_specs=(spec, spec))(key, value)
 
-    k, v = run(skv.key, skv.value)
+    return run
+
+
+def broadcast_kv(backend, mr, root: int):
+    skv = _ensure_sharded(backend, mr)
+    if skv is None:
+        return
+    mesh = skv.mesh
+    k, v = _broadcast_jit(mesh, root)(skv.key, skv.value)
     counts = np.full(backend.nprocs, skv.counts[root], np.int32)
     rowbytes = (skv.key.dtype.itemsize *
                 (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +

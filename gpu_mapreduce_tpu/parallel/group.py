@@ -7,6 +7,7 @@ own block and finds group boundaries under ``shard_map``; no collectives.
 
 from __future__ import annotations
 
+import functools
 from typing import Callable
 
 import jax
@@ -41,10 +42,8 @@ def _boundary(skey, valid):
     return valid & jnp.concatenate([first, diff])
 
 
-def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
-    """Per-shard sort + boundary detection → grouped frame."""
-    mesh = skv.mesh
-    nprocs = mesh_axis_size(mesh)
+@functools.lru_cache(maxsize=None)
+def _convert_phase1_jit(mesh):
     spec = P(AXIS)
 
     @jax.jit
@@ -57,10 +56,12 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
                              in_specs=(spec, spec, spec),
                              out_specs=(spec, spec, spec, spec))(key, value, count)
 
-    counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
-    skey, svalue, mask, ucounts = phase1(skv.key, skv.value, counts_dev)
-    gcounts = np.asarray(ucounts).astype(np.int32)
-    gcap = round_cap(int(gcounts.max())) if gcounts.max() else 8
+    return phase1
+
+
+@functools.lru_cache(maxsize=None)
+def _convert_phase2_jit(mesh, gcap: int):
+    spec = P(AXIS)
 
     @jax.jit
     def phase2(skey, mask):
@@ -83,7 +84,21 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
         return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec),
                              out_specs=(spec, spec, spec))(skey, mask)
 
-    ukey, nvalues, voffsets = phase2(skey, mask)
+    return phase2
+
+
+def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
+    """Per-shard sort + boundary detection → grouped frame.  The jitted
+    phases are cached per (mesh, gcap) — iterative commands convert every
+    round and must not re-trace (see shuffle._phase1_jit)."""
+    mesh = skv.mesh
+    counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
+    skey, svalue, mask, ucounts = _convert_phase1_jit(mesh)(
+        skv.key, skv.value, counts_dev)
+    gcounts = np.asarray(ucounts).astype(np.int32)
+    gcap = round_cap(int(gcounts.max())) if gcounts.max() else 8
+
+    ukey, nvalues, voffsets = _convert_phase2_jit(mesh, gcap)(skey, mask)
     # NOTE: rows past `count` were sorted to the end and are not in any group
     # (their seg id never advances past the last boundary of valid rows —
     # but padding rows after the last valid row share its seg id).  Correct
@@ -123,12 +138,19 @@ def _local_segment_ids(voff, nval, vcap: int):
     return jnp.cumsum(starts[:vcap]) - 1
 
 
-def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
-                   values_transform: Callable = None) -> ShardedKV:
-    """Vectorised reduce: one output pair per group, computed with XLA
-    segment ops per shard (count/sum/max/min)."""
-    mesh = kmv.mesh
-    gcap = kmv.gcap
+def _reduce_jit(mesh, gcap: int, op: str, values_transform):
+    """Cache only transform-free reduces (see shuffle._phase1_jit)."""
+    if values_transform is not None:
+        return _reduce_build(mesh, gcap, op, values_transform)
+    return _reduce_cached(mesh, gcap, op, None)
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_cached(mesh, gcap, op, values_transform):
+    return _reduce_build(mesh, gcap, op, values_transform)
+
+
+def _reduce_build(mesh, gcap: int, op: str, values_transform):
     spec = P(AXIS)
 
     @jax.jit
@@ -160,7 +182,17 @@ def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
                              out_specs=(spec, spec))(ukey, nval, voff, values,
                                                      vcount)
 
-    vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32), row_sharding(mesh))
+    return run
+
+
+def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
+                   values_transform: Callable = None) -> ShardedKV:
+    """Vectorised reduce: one output pair per group, computed with XLA
+    segment ops per shard (count/sum/max/min).  Cached per (mesh, gcap,
+    op, transform identity)."""
+    run = _reduce_jit(kmv.mesh, kmv.gcap, op, values_transform)
+    vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32),
+                                 row_sharding(kmv.mesh))
     ukey, out = run(kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values, vcounts_dev)
     return ShardedKV(kmv.mesh, ukey, out, kmv.gcounts.copy())
 
@@ -181,9 +213,8 @@ def _huge(dtype):
     return jnp.array(v, dtype=dtype)
 
 
-def first_sharded(kmv: ShardedKMV) -> ShardedKV:
-    """One output pair per group with the group's FIRST value (dedupe/cull)."""
-    mesh = kmv.mesh
+@functools.lru_cache(maxsize=None)
+def _first_jit(mesh):
     spec = P(AXIS)
 
     @jax.jit
@@ -194,17 +225,17 @@ def first_sharded(kmv: ShardedKMV) -> ShardedKV:
         return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=(spec, spec))(ukey, voff, values)
 
-    uk, v = run(kmv.ukey, kmv.voffsets, kmv.values)
+    return run
+
+
+def first_sharded(kmv: ShardedKMV) -> ShardedKV:
+    """One output pair per group with the group's FIRST value (dedupe/cull)."""
+    uk, v = _first_jit(kmv.mesh)(kmv.ukey, kmv.voffsets, kmv.values)
     return ShardedKV(kmv.mesh, uk, v, kmv.gcounts.copy())
 
 
-def sort_multivalues_sharded(kmv: ShardedKMV,
-                             descending: bool = False) -> ShardedKMV:
-    """Sort values within each group, per shard (reference
-    src/mapreduce.cpp:2210-2352).  Stable lexsort by (validity, group,
-    value) keeps every group in its original [voffset, voffset+nvalue)
-    region, so offsets/sizes are unchanged."""
-    mesh = kmv.mesh
+@functools.lru_cache(maxsize=None)
+def _sortmv_jit(mesh, descending: bool):
     spec = P(AXIS)
 
     @jax.jit
@@ -220,8 +251,19 @@ def sort_multivalues_sharded(kmv: ShardedKMV,
         return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 4,
                              out_specs=spec)(voff, nval, values, vcount)
 
-    vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32), row_sharding(mesh))
-    values = run(kmv.voffsets, kmv.nvalues, kmv.values, vcounts_dev)
+    return run
+
+
+def sort_multivalues_sharded(kmv: ShardedKMV,
+                             descending: bool = False) -> ShardedKMV:
+    """Sort values within each group, per shard (reference
+    src/mapreduce.cpp:2210-2352).  Stable lexsort by (validity, group,
+    value) keeps every group in its original [voffset, voffset+nvalue)
+    region, so offsets/sizes are unchanged."""
+    vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32),
+                                 row_sharding(kmv.mesh))
+    values = _sortmv_jit(kmv.mesh, descending)(
+        kmv.voffsets, kmv.nvalues, kmv.values, vcounts_dev)
     return ShardedKMV(kmv.mesh, kmv.ukey, kmv.nvalues, kmv.voffsets, values,
                       kmv.gcounts.copy(), kmv.vcounts.copy())
 
@@ -236,9 +278,8 @@ def _desc_key(v):
 # per-shard sort (reference sort_keys/sort_values are rank-local)
 # ---------------------------------------------------------------------------
 
-def sort_sharded(skv: ShardedKV, by: str = "key",
-                 descending: bool = False) -> ShardedKV:
-    mesh = skv.mesh
+@functools.lru_cache(maxsize=None)
+def _sort_jit(mesh, by: str, descending: bool):
     spec = P(AXIS)
 
     @jax.jit
@@ -257,6 +298,12 @@ def sort_sharded(skv: ShardedKV, by: str = "key",
         return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=(spec, spec))(key, value, count)
 
-    counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
-    k, v = run(skv.key, skv.value, counts_dev)
-    return ShardedKV(mesh, k, v, skv.counts.copy())
+    return run
+
+
+def sort_sharded(skv: ShardedKV, by: str = "key",
+                 descending: bool = False) -> ShardedKV:
+    counts_dev = jax.device_put(skv.counts.astype(np.int32),
+                                row_sharding(skv.mesh))
+    k, v = _sort_jit(skv.mesh, by, descending)(skv.key, skv.value, counts_dev)
+    return ShardedKV(skv.mesh, k, v, skv.counts.copy())

@@ -137,30 +137,66 @@ def _compact(recv, counts_from, cap_out: int):
     return packed, jnp.sum(counts_from)
 
 
-def exchange(skv: ShardedKV, dest_of: Callable, transport: int = 1,
-             counters=None) -> ShardedKV:
-    """Full ragged exchange: route every valid row to dest_of(keys) shard."""
-    mesh = skv.mesh
-    nprocs = mesh_axis_size(mesh)
-    spec_rows, spec_cnt = P(AXIS), P(AXIS)
+def _dest_fn(dest, nprocs: int) -> Callable:
+    """Destination spec → per-row dest function.  Specs are hashable so
+    the jitted phase1 caches across calls (the iterative graph commands
+    re-shuffle every round; re-jitting per round was the dominant cost):
 
-    @functools.partial(jax.jit)
+    * ("hash", fn_or_None) — fn(keys)%nprocs, default lookup3;
+    * ("fixed_mod", n) — every row of shard i to shard i%n (gather)."""
+    kind = dest[0]
+    if kind == "hash":
+        fn = dest[1]
+        if fn is None:
+            return lambda keys: default_hash(keys) % nprocs
+        return lambda keys: fn(keys) % nprocs
+    if kind == "fixed_mod":
+        n = dest[1]
+
+        def fixed(keys):
+            me = lax.axis_index(AXIS)
+            d = (me % n).astype(jnp.int32)
+            return jnp.full(keys.shape[0], d, jnp.int32)
+        return fixed
+    raise ValueError(dest)
+
+
+def _phase1_jit(mesh, dest):
+    """Cache the jitted phase1 only for stable dest specs — a per-call
+    user hash lambda would defeat reuse AND pin every executable forever
+    in an unbounded cache, so those build uncached (old behavior)."""
+    if dest[0] == "hash" and dest[1] is not None:
+        return _phase1_build(mesh, dest)
+    return _phase1_cached(mesh, dest)
+
+
+@functools.lru_cache(maxsize=None)
+def _phase1_cached(mesh, dest):
+    return _phase1_build(mesh, dest)
+
+
+def _phase1_build(mesh, dest):
+    nprocs = mesh_axis_size(mesh)
+    dest_of = _dest_fn(dest, nprocs)
+    spec = P(AXIS)
+
+    @jax.jit
     def phase1(key, value, count):
         f = functools.partial(_phase1, nprocs, dest_of)
         return jax.shard_map(
-            f, mesh=mesh,
-            in_specs=(spec_rows, spec_rows, spec_cnt),
-            out_specs=(spec_rows, spec_rows, spec_cnt))(key, value, count)
+            f, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec, spec, spec))(key, value, count)
 
-    counts_dev = jax.device_put(skv.counts.astype(np.int32),
-                                row_sharding(mesh))
-    skey, svalue, counts_local = phase1(skv.key, skv.value, counts_dev)
-    counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
-    B = round_cap(int(counts_mat.max())) if counts_mat.max() else 8
-    new_counts = counts_mat.sum(axis=0).astype(np.int32)
-    cap_out = round_cap(int(new_counts.max())) if new_counts.max() else 8
+    return phase1
 
-    def phase2_fn(skey, svalue, counts_local):
+
+@functools.lru_cache(maxsize=None)
+def _phase2_jit(mesh, transport: int, B: int, cap_out: int):
+    nprocs = mesh_axis_size(mesh)
+    spec = P(AXIS)
+
+    @jax.jit
+    def phase2(skey, svalue, counts_local):
         def body(k, v, cl):
             counts_from = _exchange_counts(cl, transport)
             recv_k = _exchange_blocks(_build_send(nprocs, B, k, cl), transport)
@@ -169,11 +205,30 @@ def exchange(skv: ShardedKV, dest_of: Callable, transport: int = 1,
             out_v, _ = _compact(recv_v, counts_from, cap_out)
             return out_k, out_v
         return jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(spec_rows, spec_rows, spec_cnt),
-            out_specs=(spec_rows, spec_rows))(skey, svalue, counts_local)
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec, spec))(skey, svalue, counts_local)
 
-    out_k, out_v = jax.jit(phase2_fn)(skey, svalue, counts_local)
+    return phase2
+
+
+def exchange(skv: ShardedKV, dest, transport: int = 1,
+             counters=None) -> ShardedKV:
+    """Full ragged exchange: route every valid row to its dest shard.
+    ``dest`` is a hashable spec (see :func:`_dest_fn`)."""
+    mesh = skv.mesh
+    nprocs = mesh_axis_size(mesh)
+
+    counts_dev = jax.device_put(skv.counts.astype(np.int32),
+                                row_sharding(mesh))
+    skey, svalue, counts_local = _phase1_jit(mesh, dest)(
+        skv.key, skv.value, counts_dev)
+    counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
+    B = round_cap(int(counts_mat.max())) if counts_mat.max() else 8
+    new_counts = counts_mat.sum(axis=0).astype(np.int32)
+    cap_out = round_cap(int(new_counts.max())) if new_counts.max() else 8
+
+    out_k, out_v = _phase2_jit(mesh, transport, B, cap_out)(
+        skey, svalue, counts_local)
     if counters is not None:
         rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
                     skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
@@ -203,13 +258,8 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
         skv = shard_frame(frame, backend.mesh)
     else:
         skv = frame  # already sharded
-    nprocs = backend.nprocs
-    if hash_fn is not None:
-        dest_of = lambda keys: hash_fn(keys) % nprocs
-    else:
-        dest_of = lambda keys: default_hash(keys) % nprocs
     t = Timer()
-    out = exchange(skv, dest_of, transport=mr.settings.all2all,
+    out = exchange(skv, ("hash", hash_fn), transport=mr.settings.all2all,
                    counters=mr.counters)
     mr.counters.commtime += t.elapsed()
     _replace_kv_frames(kv, out)

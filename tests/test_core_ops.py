@@ -321,3 +321,32 @@ def test_kmv_spill_splits_to_budget(tmp_path):
     import os
     assert all(os.path.getsize(p) < 3 * (1 << 20) for p in spills)
     assert mr.reduce(count, batch=True) == 4000
+
+
+def test_intcount_app(tmp_path, rng):
+    import collections
+    import numpy as np
+    from gpu_mapreduce_tpu.apps.intcount import intcount
+
+    data = rng.integers(0, 50, size=6000).astype(np.uint32)
+    f1, f2 = tmp_path / "a.bin", tmp_path / "b.bin"
+    data[:3000].tofile(f1)
+    data[3000:].tofile(f2)
+    nints, nunique, top = intcount([str(f1), str(f2)], ntop=5)
+    oracle = collections.Counter(data.tolist())
+    assert nints == 6000 and nunique == len(oracle)
+    assert [c for _, c in top] == [c for _, c in oracle.most_common(5)]
+
+
+def test_intcount_app_mesh(tmp_path, rng):
+    import collections
+    import numpy as np
+    from gpu_mapreduce_tpu.apps.intcount import intcount
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    data = rng.integers(0, 99, size=4096).astype(np.uint32)
+    f = tmp_path / "m.bin"
+    data.tofile(f)
+    nints, nunique, _ = intcount([str(f)], comm=make_mesh(4))
+    assert nints == 4096
+    assert nunique == len(set(data.tolist()))
