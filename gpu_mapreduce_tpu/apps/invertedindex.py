@@ -15,8 +15,10 @@ table, not repeated strings.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -28,6 +30,7 @@ from .. import native
 from ..ops.hash import hash_bytes64_batch
 from ..ops.pallas.match import url_lengths
 from ..utils.io import findfiles
+from ..utils.platform import is_tpu_backend
 
 PATTERN = b'<a href="'
 QUOTE = ord('"')
@@ -84,20 +87,49 @@ def _chunk_iter(data: np.ndarray):
         yield buf, base, nvalid
 
 
-def _device_extract(data: np.ndarray, use_pallas: bool, interpret: bool):
+class StageTimer:
+    """Cumulative wall-clock per pipeline stage (reference instrument:
+    gettimeofday/cudaEvent pairs around each kernel,
+    cuda/InvertedIndex.cu:337,360,369,384)."""
+
+    def __init__(self):
+        self.times: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.times[name] = (self.times.get(name, 0.0)
+                                + time.perf_counter() - t0)
+
+
+def _device_extract(data: np.ndarray, use_pallas: bool, interpret: bool,
+                    timer: Optional[StageTimer] = None):
     """One file's bytes → (starts, lengths) host arrays, chunked through
-    shape-cached compiled kernels (one compile per pow2 chunk size)."""
+    shape-cached compiled kernels (one compile per pow2 chunk size).
+
+    When ``timer`` is given, extra device syncs attribute time to stages;
+    untimed callers keep the fully async dispatch path."""
+    sync = jax.block_until_ready if timer is not None else (lambda x: x)
+    timer = timer or StageTimer()
     all_starts, all_lengths = [], []
     for buf_np, base, nvalid in _chunk_iter(data):
-        buf = jnp.asarray(buf_np)
-        mask, nhits = _mark_count_fn(PATTERN, use_pallas, interpret)(buf, nvalid)
-        nhits = int(nhits)
+        with timer.stage("h2d"):
+            buf = sync(jnp.asarray(buf_np))
+        with timer.stage("mark"):
+            mask, nhits = _mark_count_fn(PATTERN, use_pallas, interpret)(
+                buf, nvalid)
+            nhits = int(nhits)
         if nhits == 0:
             continue
         cap = max(8, 1 << (nhits - 1).bit_length())
-        starts, lengths = _compact_len_fn(cap)(buf, mask)
-        all_starts.append(np.asarray(starts[:nhits], np.int64) + base)
-        all_lengths.append(np.asarray(lengths[:nhits]))
+        with timer.stage("compact_len"):
+            starts, lengths = sync(_compact_len_fn(cap)(buf, mask))
+        with timer.stage("d2h"):
+            all_starts.append(np.asarray(starts[:nhits], np.int64) + base)
+            all_lengths.append(np.asarray(lengths[:nhits]))
     if not all_starts:
         return np.zeros(0, np.int64), np.zeros(0, np.int32)
     return np.concatenate(all_starts), np.concatenate(all_lengths)
@@ -124,12 +156,16 @@ class InvertedIndex:
         self.engine = engine
         self.use_pallas = engine == "pallas"
         if interpret is None:
-            interpret = backend != "tpu"  # CPU tests interpret the kernel
+            # CPU tests interpret the kernel; real hardware (including the
+            # axon plugin backend) must compile via Mosaic — interpret mode
+            # on chip would silently invalidate any benchmark number
+            interpret = not is_tpu_backend(backend)
         self.interpret = interpret
         self.comm = comm
         self.urls: Dict[int, bytes] = {}
         self.docs: List[str] = []
         self.npairs = 0
+        self.timer = StageTimer()
 
     # -- map stage -------------------------------------------------------
     def _map_file(self, itask, filename, kv, ptr):
@@ -140,23 +176,26 @@ class InvertedIndex:
         if len(data) == 0:
             return
         if self.engine == "native":
-            starts, lengths = native.find_hrefs(data)
+            with self.timer.stage("native_scan"):
+                starts, lengths = native.find_hrefs(data)
             # device path drops URLs with no terminator within MAX_URL;
             # match that instead of silently truncating
             lengths = np.where(lengths > MAX_URL, -1, lengths)
         else:
             starts, lengths = _device_extract(data, self.use_pallas,
-                                              self.interpret)
-        keep = lengths >= 0  # unterminated href — reference runs off; we drop
-        urls = [data[st:st + ln].tobytes()
-                for st, ln in zip(starts[keep], lengths[keep])]
-        ids = hash_bytes64_batch(urls)  # native C++ batch intern
-        for h, url in zip(ids.tolist(), urls):
-            prev = self.urls.get(h)
-            if prev is not None and prev != url:
-                raise ValueError(f"64-bit URL intern collision: {prev!r} vs {url!r}")
-            self.urls[h] = url
-        kv.add_batch(ids, np.full(len(ids), doc_id, dtype=np.uint32))
+                                              self.interpret, self.timer)
+        with self.timer.stage("host_add"):
+            keep = lengths >= 0  # unterminated href: reference runs off; we drop
+            urls = [data[st:st + ln].tobytes()
+                    for st, ln in zip(starts[keep], lengths[keep])]
+            ids = hash_bytes64_batch(urls)  # native C++ batch intern
+            for h, url in zip(ids.tolist(), urls):
+                prev = self.urls.get(h)
+                if prev is not None and prev != url:
+                    raise ValueError(
+                        f"64-bit URL intern collision: {prev!r} vs {url!r}")
+                self.urls[h] = url
+            kv.add_batch(ids, np.full(len(ids), doc_id, dtype=np.uint32))
 
     # -- full pipeline ---------------------------------------------------
     def run(self, paths: Sequence[str], outdir: Optional[str] = None,
@@ -168,9 +207,12 @@ class InvertedIndex:
         files = findfiles(list(paths))
         if nfiles is not None:
             files = files[:nfiles]
-        self.npairs = mr.map_files(files, self._map_file)
-        mr.aggregate()
-        mr.convert()
+        with self.timer.stage("map"):
+            self.npairs = mr.map_files(files, self._map_file)
+        with self.timer.stage("aggregate"):
+            mr.aggregate()
+        with self.timer.stage("convert"):
+            mr.convert()
 
         out = None
         nurl = [0]
@@ -187,7 +229,8 @@ class InvertedIndex:
             if outdir:
                 os.makedirs(outdir, exist_ok=True)
                 out = open(os.path.join(outdir, "part-00000"), "w")
-            mr.reduce(emit)
+            with self.timer.stage("reduce"):
+                mr.reduce(emit)
         finally:
             if out is not None:
                 out.close()

@@ -1,0 +1,32 @@
+"""Platform pinning against TPU-plugin config overrides.
+
+The axon TPU plugin's ``register()`` forces ``jax_platforms="axon,cpu"``
+via ``jax.config``, which silently beats the ``JAX_PLATFORMS`` environment
+variable.  Anything that must honour the env var (the driver's CPU
+multi-chip dry-run, the test suite's fake 8-device cluster, bench.py's
+fallback) needs to sync ``jax.config`` back — this is the one shared
+implementation (round-1 review: three hand-rolled copies drifted).
+"""
+
+import os
+
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def pin_platform(force: str | None = None) -> None:
+    """Sync ``jax.config`` to ``force`` or the JAX_PLATFORMS env var.
+
+    No-op when neither is set, leaving the plugin default (real TPU)
+    alone.  Safe to call any time before first device access.
+    """
+    want = force or os.environ.get("JAX_PLATFORMS")
+    if want:
+        if force:
+            os.environ["JAX_PLATFORMS"] = force
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
+def is_tpu_backend(name: str) -> bool:
+    return name in TPU_BACKENDS
