@@ -265,6 +265,27 @@ REDUCE_KERNELS = {
 }
 
 
+def hash_lookup3(keys):
+    """The library default key→proc hash, by name (reference scripts pass
+    NULL for the same thing; mrmpi.cpp:354-466 resolves named hashes)."""
+    from ..parallel.shuffle import default_hash
+    return default_hash(keys)
+
+
+def hash_identity(keys):
+    """Low word of the key as the hash — deterministic placement for
+    tests/scripts (shard = key % nprocs)."""
+    import jax.numpy as jnp
+    k = keys[:, 0] if keys.ndim > 1 else keys
+    return k.astype(jnp.uint32)
+
+
+HASH_KERNELS = {
+    "lookup3": hash_lookup3,
+    "identity": hash_identity,
+}
+
+
 def print_edge(k, v, fp):
     fp.write(f"{k[0]} {k[1]}\n")
 

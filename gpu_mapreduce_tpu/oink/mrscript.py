@@ -95,7 +95,7 @@ class MRScriptDispatch:
         if len(a) != 1:
             raise MRError("Illegal MR object aggregate command")
         mr.aggregate(None if a[0] == "NULL" else
-                     _lookup({}, a[0], "hash"))
+                     _lookup(kernels.HASH_KERNELS, a[0], "hash"))
 
     def m_broadcast(self, name, mr, a):
         if len(a) != 1:
@@ -120,7 +120,7 @@ class MRScriptDispatch:
         if len(a) != 1:
             raise MRError("Illegal MR object collate command")
         mr.collate(None if a[0] == "NULL" else
-                   _lookup({}, a[0], "hash"))
+                   _lookup(kernels.HASH_KERNELS, a[0], "hash"))
 
     def m_compress(self, name, mr, a):
         if len(a) != 1:

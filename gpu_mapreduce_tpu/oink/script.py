@@ -64,9 +64,17 @@ class OinkScript:
         self._jump_to: Optional[tuple] = None   # (filename-or-SELF, lines)
 
     def _nprocs(self) -> int:
-        mr = self.obj.create_mr()
-        n = getattr(mr.backend, "nprocs", 1)
-        return int(n() if callable(n) else n)
+        # query the backend directly — creating (and leaking until the
+        # next command cleanup) a temp MR per `$p` substitution
+        # accumulated live objects
+        if not hasattr(self, "_nprocs_cache"):
+            comm = self.obj.comm
+            if comm is None or isinstance(comm, int):
+                self._nprocs_cache = 1
+            else:
+                from ..parallel.mesh import mesh_axis_size
+                self._nprocs_cache = mesh_axis_size(comm)
+        return self._nprocs_cache
 
     def close(self):
         if self.logfile:

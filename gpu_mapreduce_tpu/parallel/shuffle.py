@@ -92,10 +92,15 @@ def _build_send(nprocs: int, B: int, rows, counts_local, round_idx: int = 0):
     r = jnp.arange(cap)
     d = jnp.searchsorted(cum, r, side="right").astype(jnp.int32)  # dest of row r
     off = jnp.concatenate([jnp.zeros(1, jnp.int32), cum[:-1].astype(jnp.int32)])
-    q = r - jnp.take(off, jnp.minimum(d, nprocs - 1)) - round_idx * B
+    q0 = r - jnp.take(off, jnp.minimum(d, nprocs - 1))  # slot within bucket
+    # rows outside this round's window must go POSITIVELY out of bounds:
+    # a negative q wraps NumPy-style (idx+B) before mode="drop" checks, so
+    # earlier rounds' rows would scatter into [0,B) and corrupt this round
+    in_window = (q0 >= round_idx * B) & (q0 < (round_idx + 1) * B)
+    q = jnp.where(in_window, q0 - round_idx * B, B)
     shape = (nprocs, B) + rows.shape[1:]
     send = jnp.zeros(shape, rows.dtype)
-    # rows with d==nprocs (padding) or q outside this round → dropped
+    # rows with d==nprocs (padding) or q==B (other round) → dropped
     return send.at[d, q].set(rows, mode="drop")
 
 

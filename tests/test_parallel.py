@@ -189,3 +189,65 @@ def test_wordfreq_interned_on_mesh(tmp_path, mesh):
     # compare counts only: rank 3 is a six-way tie at 50, so word identity
     # at the tail is an incidental tie-break of each execution path
     assert [c for _, c in top_s] == [c for _, c in top_m] == [150, 100, 50]
+
+
+@pytest.mark.parametrize("all2all", [1, 0])
+def test_skewed_exchange_multi_round(mesh, all2all, monkeypatch):
+    """Skewed buckets force nrounds > 1 in the flow-controlled exchange;
+    round-window rows must not wrap into earlier rounds (round-1 advisor
+    finding: negative scatter indices wrapped before mode='drop')."""
+    from gpu_mapreduce_tpu.core.frame import KVFrame
+    from gpu_mapreduce_tpu.core.column import DenseColumn
+    from gpu_mapreduce_tpu.parallel import shuffle
+    from gpu_mapreduce_tpu.parallel.sharded import shard_frame
+
+    # per shard: ~1 row to each dest 1..7, a pile of rows to dest 0 —
+    # mean nonzero bucket << max bucket ⇒ multi-round
+    rng = np.random.default_rng(99)
+    hub = np.zeros(2000, np.uint64)            # dest 0 via key % 8
+    tail = rng.integers(1, 8, size=56).astype(np.uint64)
+    keys = np.concatenate([hub, tail])
+    rng.shuffle(keys)
+    vals = np.arange(len(keys), dtype=np.uint64)
+
+    seen = {}
+    orig = shuffle._phase2_jit
+
+    def spy(mesh_, transport, B, nrounds, cap_out):
+        seen["nrounds"] = nrounds
+        return orig(mesh_, transport, B, nrounds, cap_out)
+
+    monkeypatch.setattr(shuffle, "_phase2_jit", spy)
+    skv = shard_frame(KVFrame(DenseColumn(keys), DenseColumn(vals)), mesh)
+    dest = ("hash", lambda k: k.astype(np.uint32))
+    out = shuffle.exchange(skv, dest, transport=all2all)
+    assert seen["nrounds"] > 1, "test no longer exercises the multi-round path"
+    assert multiset(out.to_host().pairs()) == multiset(zip(keys, vals))
+    P, cap = out.nprocs, out.cap
+    k = np.asarray(out.key).reshape(P, cap)
+    for i in range(P):
+        assert (k[i, :out.counts[i]] % P == i).all()
+
+
+def test_build_send_round_window_no_wrap():
+    """_build_send round r must contain EXACTLY bucket slots [rB, rB+B) —
+    the round-1 advisor bug wrapped the previous round's rows (negative
+    scatter indices) into this round's buffer, which XLA may keep or drop
+    depending on unspecified duplicate-update order."""
+    import jax.numpy as jnp
+    from gpu_mapreduce_tpu.parallel.shuffle import _build_send
+
+    nprocs, B = 4, 4
+    # bucket 0: 10 rows, bucket 1: 1 row, bucket 2: 0 rows, bucket 3: 2 rows
+    counts = jnp.array([10, 1, 0, 2], jnp.int32)
+    rows = jnp.arange(1, 17, dtype=jnp.uint64)  # 13 real + 3 padding, no zeros
+    for r in range(3):
+        send = np.asarray(_build_send(nprocs, B, rows, counts, r))
+        expect = np.zeros((nprocs, B), np.uint64)
+        offs = [0, 10, 11, 11]
+        for d in range(nprocs):
+            for s in range(B):
+                q0 = r * B + s
+                if q0 < counts[d]:
+                    expect[d, s] = rows[offs[d] + q0]
+        np.testing.assert_array_equal(send, expect, err_msg=f"round {r}")
