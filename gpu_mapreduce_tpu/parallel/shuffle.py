@@ -104,36 +104,45 @@ def _build_send(nprocs: int, B: int, rows, counts_local, round_idx: int = 0):
     return send.at[d, q].set(rows, mode="drop")
 
 
+def _ring_exchange(send):
+    """Systolic shift-by-one ring: recv[j] = what shard j holds for me.
+
+    The reference's second transport is a hand-rolled Irecv/Send ring
+    (``irregular.cpp:311-363``).  Round 1 unrolled one ppermute per shift
+    distance k — O(P) collectives of O(P·B) state each, an O(P²) trace
+    that stops compiling at pod scale.  This version keeps the *same*
+    single shift-by-one permutation every step inside ``lax.fori_loop``
+    (ppermute's permutation must be trace-static, so a varying shift can't
+    live in the loop): after s shifts my buffer is shard (me-s)'s original
+    send array, and its row [me] is that shard's block for me."""
+    nprocs = send.shape[0]
+    me = lax.axis_index(AXIS)
+    perm = [(i, (i + 1) % nprocs) for i in range(nprocs)]
+    recv = jnp.zeros_like(send)
+    recv = recv.at[me].set(send[me])  # self-copy overlap (irregular.cpp:311)
+
+    def body(s, carry):
+        buf, recv = carry
+        buf = lax.ppermute(buf, AXIS, perm)
+        recv = recv.at[(me - s) % nprocs].set(buf[me])
+        return buf, recv
+
+    _, recv = lax.fori_loop(1, nprocs, body, (send, recv))
+    return recv
+
+
 def _exchange_counts(counts_local, transport: int):
     """Exchange per-dest counts: counts_from[j] = rows shard j sends me."""
-    nprocs = counts_local.shape[0]
     if transport == 1:
         return lax.all_to_all(counts_local[:, None], AXIS, 0, 0)[:, 0]
-    me = lax.axis_index(AXIS)
-    counts_from = jnp.zeros_like(counts_local)
-    counts_from = counts_from.at[me].set(counts_local[me])
-    for k in range(1, nprocs):
-        perm = [(i, (i + k) % nprocs) for i in range(nprocs)]
-        cnt = jnp.take(counts_local, (me + k) % nprocs)
-        counts_from = counts_from.at[(me - k) % nprocs].set(
-            lax.ppermute(cnt, AXIS, perm))
-    return counts_from
+    return _ring_exchange(counts_local[:, None])[:, 0]
 
 
 def _exchange_blocks(send, transport: int):
     """[P,B,...] send blocks → [P,B,...] recv blocks."""
-    nprocs = send.shape[0]
     if transport == 1:
         return lax.all_to_all(send, AXIS, 0, 0)
-    # ppermute ring (the reference's pre-posted Irecv/Send transport)
-    me = lax.axis_index(AXIS)
-    recv = jnp.zeros_like(send)
-    recv = recv.at[me].set(send[me])  # self-copy overlap (irregular.cpp:311)
-    for k in range(1, nprocs):
-        perm = [(i, (i + k) % nprocs) for i in range(nprocs)]
-        blk = jnp.take(send, (me + k) % nprocs, axis=0)
-        recv = recv.at[(me - k) % nprocs].set(lax.ppermute(blk, AXIS, perm))
-    return recv
+    return _ring_exchange(send)
 
 
 def _compact(recv, counts_from, cap_out: int):
