@@ -1,16 +1,33 @@
 """InvertedIndex — the reference's flagship GPU application, TPU-native.
 
 Pipeline (reference ``cuda/InvertedIndex.cu:140-202``, call stack SURVEY.md
-§3.6): per HTML file, find every ``<a href="..."`` URL (device kernels),
-emit (url, filename) pairs; ``aggregate`` shuffles URLs across chips;
+§3.6): find every ``<a href="..."`` URL in an HTML corpus (device kernels),
+emit (url, doc) pairs; ``aggregate`` shuffles URLs across chips;
 ``convert`` groups; ``reduce`` writes ``url \\t file file...`` lines to
 per-proc output files (``:463-513``).
 
-Device stages (Pallas/XLA, ops/pallas/match.py): mark → compact →
-url_lengths.  The host loop then interns URL bytes to u64 ids and bulk-adds
-(url_id, doc_id) — the analogue of the reference's host ``kv->add`` loop
-(``:385-388``), but batched.  File *names* are u32 doc ids into a host
-table, not repeated strings.
+TPU re-design of the map stage (round 2).  The reference dispatches four
+GPU stages per 64 MB chunk plus a host kv->add loop (mark 4 ms + copy_if
+14 ms + length 8 ms + add 18 ms, ``cuda/InvertedIndex.cu:337-384``).  Here
+the WHOLE corpus map stage is ONE fused XLA program over a u32-resident
+buffer:
+
+    mark (word-packed Pallas kernel, 4 bytes/lane)
+    → compact (jnp.nonzero on the 4×-smaller word mask)
+    → URL windows as unaligned u32 loads (no byte arrays on device)
+    → closing-quote scan + masked lookup3 → u64 URL ids ON DEVICE
+    → doc ids by searchsorted over file offsets
+    → valid-row packing
+
+Device-resident output: the packed (url_id, doc_id) columns feed the mesh
+backend's sharded KV directly — no device→host round trip anywhere in the
+map stage.  URL *bytes* are sliced from the host copy of the corpus only
+when an output dictionary is actually needed; the device and host interns
+produce bit-identical u64 ids (ops/hash.py), so the tiers interoperate.
+
+One dispatch instead of ~4/chunk matters doubly here: each dispatch to the
+chip costs ~10s of ms of launch latency in tunneled setups, and XLA can
+overlap/fuse the stages it can see.
 """
 
 from __future__ import annotations
@@ -24,67 +41,136 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core.mapreduce import MapReduce
 from .. import native
-from ..ops.hash import hash_bytes64_batch
-from ..ops.pallas.match import url_lengths
+from ..ops.hash import hash_bytes64_batch, hash_bytes64_masked
+from ..ops.pallas.match import (bytes_view_u32, compact_word_matches,
+                                first_byte_pos, mark_words_pallas,
+                                mark_words_xla, mask_words_to_length,
+                                unaligned_words)
 from ..utils.io import findfiles
 from ..utils.platform import is_tpu_backend
 
 PATTERN = b'<a href="'
 QUOTE = ord('"')
-MAX_URL = 1024
+MAX_URL = 256               # longest URL matched; window-gather cost on the
+                            # device path is ∝ this (26ns/byte-lane on v5e),
+                            # so keep it at the realistic URL bound, not the
+                            # reference's unbounded scan
+URL_DICT_MAX = 64 << 20     # auto-build the url-bytes dict below this size
+
+_GAP = MAX_URL + len(PATTERN)  # zero gap between files: no cross-file
+                               # matches, and a URL window never bleeds
+                               # into the next file (reference scans each
+                               # file separately)
+_BS = 4096                     # rows per lax.map step in the window stage
 
 
-CHUNK = 1 << 26            # 64 MB — the reference's per-chunk unit
-MIN_CHUNK = 1 << 17        # small files pad to pow2 ≥ 128 KB
-OVERLAP = len(PATTERN) + MAX_URL
+def _build_corpus(files: Sequence[str]):
+    """Concatenate files with zero gaps; returns (bytes, file data starts).
+
+    Byte offsets travel as int32 on device (i32 is what the VPU lanes and
+    the compaction scatter want); one corpus is therefore capped at 2 GiB —
+    callers with more data run multiple corpora (the reference likewise
+    works in per-process file batches, cuda/InvertedIndex.cu:284-287)."""
+    pieces: List[np.ndarray] = []
+    starts = np.zeros(len(files), np.int64)
+    gap = np.zeros(_GAP, np.uint8)
+    off = 0
+    for i, f in enumerate(files):
+        with open(f, "rb") as fh:
+            data = np.frombuffer(fh.read(), np.uint8)
+        starts[i] = off
+        pieces.append(data)
+        pieces.append(gap)
+        off += len(data) + _GAP
+    if off >= (1 << 31):
+        raise ValueError(
+            f"corpus is {off} bytes; the fused device path indexes bytes "
+            f"with int32 — split the file list into < 2 GiB batches")
+    corpus = (np.concatenate(pieces) if pieces
+              else np.zeros(0, np.uint8))
+    return corpus, starts.astype(np.int32)
 
 
 @functools.lru_cache(maxsize=None)
-def _mark_count_fn(pattern: bytes, use_pallas: bool, interpret: bool):
-    """Compiled (per chunk-shape, cached) mark+count.  The buffer is
-    chunk+overlap bytes; matches starting in the overlap tail belong to the
-    next chunk and are masked off."""
+def _extract_fn(cap: int, use_pallas: bool, interpret: bool):
+    """The fused map stage (see module docstring).  jit re-specialises per
+    (corpus words, nfiles) shape; `cap` is the static hit capacity."""
+    bs = min(_BS, cap)
+    nw = MAX_URL // 4
 
     @jax.jit
-    def run(buf, nvalid):
-        from ..ops.pallas.match import mark_pallas, mark_xla
-        mask = (mark_pallas(buf, pattern, interpret=interpret) if use_pallas
-                else mark_xla(buf, pattern))
-        own = jnp.arange(buf.shape[0]) < nvalid
-        mask = jnp.where(own, mask.astype(jnp.int32), 0)
-        return mask, jnp.sum(mask)
+    def run(words, file_starts):
+        m = words.shape[0]
+        nbytes = 4 * m
+        wmask = (mark_words_pallas(words, PATTERN, interpret=interpret)
+                 if use_pallas else mark_words_xla(words, PATTERN))
+        starts, nhits = compact_word_matches(wmask, nbytes, cap)
+        ustarts = starts + np.int32(len(PATTERN))
+
+        def body(st):
+            win = unaligned_words(words, st, nw)
+            length = first_byte_pos(win, QUOTE)
+            l0 = jnp.maximum(length, 0)
+            wm = mask_words_to_length(win, l0)
+            ids = hash_bytes64_masked(wm, l0)
+            # independent id family: any real u64 intern collision shows as
+            # one id with two alt-ids (checked after packing, no bytes kept)
+            alt = hash_bytes64_masked(wm, l0, 0x9E3779B9, 0x85EBCA6B)
+            return ids, alt, length
+
+        ids, alts, lengths = lax.map(body, ustarts.reshape(-1, bs))
+        ids = ids.reshape(-1)
+        alts = alts.reshape(-1)
+        lengths = lengths.reshape(-1)
+        docs = (jnp.searchsorted(file_starts, starts, side="right")
+                .astype(jnp.int32) - 1)
+        valid = (starts < nbytes) & (lengths >= 0)
+        npairs = jnp.sum(valid.astype(jnp.int32))
+        order = jnp.argsort(~valid, stable=True)   # valid rows first
+        pack = lambda x: jnp.take(x, order, axis=0)
+        return (pack(ids), pack(alts), pack(docs).astype(jnp.uint32),
+                pack(ustarts), pack(lengths), nhits, npairs)
 
     return run
 
 
+def _assemble_parts(parts):
+    """Merge per-batch packed device columns into one packed column set.
+    Single batch (the common case) is zero-copy; multi-batch concatenates
+    the valid row slices on device and re-pads to a power-of-two cap."""
+    if len(parts) == 1:
+        return parts[0]
+    ntot = sum(p[3] for p in parts)
+    cap = max(8, 1 << (ntot - 1).bit_length()) if ntot else 8
+
+    def cat(i):
+        pieces = [p[i][:p[3]] for p in parts]
+        tail = cap - ntot
+        if tail:
+            pieces.append(jnp.zeros((tail,), pieces[0].dtype))
+        return jnp.concatenate(pieces)
+
+    return cat(0), cat(1), cat(2), ntot
+
+
 @functools.lru_cache(maxsize=None)
-def _compact_len_fn(cap: int):
+def _collision_check_fn():
     @jax.jit
-    def run(buf, mask):
-        from ..ops.pallas.match import compact_matches
-        starts, _ = compact_matches(mask, cap)
-        starts = starts + len(PATTERN)
-        lengths, _ = url_lengths(buf, starts, QUOTE, MAX_URL)
-        return starts, lengths
+    def run(ids, alts, npairs):
+        valid = jnp.arange(ids.shape[0]) < npairs
+        order = jnp.lexsort((alts, jnp.where(valid, ids, jnp.uint64(0)),
+                             ~valid))
+        a = jnp.take(ids, order)
+        b = jnp.take(alts, order)
+        v = jnp.take(valid, order)
+        bad = (a[1:] == a[:-1]) & (b[1:] != b[:-1]) & v[1:] & v[:-1]
+        return jnp.sum(bad.astype(jnp.int32))
 
     return run
-
-
-def _chunk_iter(data: np.ndarray):
-    """Yield (padded chunk+overlap buffer, base offset, valid bytes)."""
-    n = len(data)
-    chunk = MIN_CHUNK
-    while chunk < min(n, CHUNK):
-        chunk <<= 1
-    for base in range(0, n, chunk):
-        nvalid = min(chunk, n - base)
-        buf = np.zeros(chunk + OVERLAP, np.uint8)
-        take = min(chunk + OVERLAP, n - base)
-        buf[:take] = data[base:base + take]
-        yield buf, base, nvalid
 
 
 class StageTimer:
@@ -103,36 +189,6 @@ class StageTimer:
         finally:
             self.times[name] = (self.times.get(name, 0.0)
                                 + time.perf_counter() - t0)
-
-
-def _device_extract(data: np.ndarray, use_pallas: bool, interpret: bool,
-                    timer: Optional[StageTimer] = None):
-    """One file's bytes → (starts, lengths) host arrays, chunked through
-    shape-cached compiled kernels (one compile per pow2 chunk size).
-
-    When ``timer`` is given, extra device syncs attribute time to stages;
-    untimed callers keep the fully async dispatch path."""
-    sync = jax.block_until_ready if timer is not None else (lambda x: x)
-    timer = timer or StageTimer()
-    all_starts, all_lengths = [], []
-    for buf_np, base, nvalid in _chunk_iter(data):
-        with timer.stage("h2d"):
-            buf = sync(jnp.asarray(buf_np))
-        with timer.stage("mark"):
-            mask, nhits = _mark_count_fn(PATTERN, use_pallas, interpret)(
-                buf, nvalid)
-            nhits = int(nhits)
-        if nhits == 0:
-            continue
-        cap = max(8, 1 << (nhits - 1).bit_length())
-        with timer.stage("compact_len"):
-            starts, lengths = sync(_compact_len_fn(cap)(buf, mask))
-        with timer.stage("d2h"):
-            all_starts.append(np.asarray(starts[:nhits], np.int64) + base)
-            all_lengths.append(np.asarray(lengths[:nhits]))
-    if not all_starts:
-        return np.zeros(0, np.int64), np.zeros(0, np.int32)
-    return np.concatenate(all_starts), np.concatenate(all_lengths)
 
 
 class InvertedIndex:
@@ -167,35 +223,137 @@ class InvertedIndex:
         self.npairs = 0
         self.timer = StageTimer()
 
-    # -- map stage -------------------------------------------------------
-    def _map_file(self, itask, filename, kv, ptr):
+    # -- map stage: native (host C++) tier --------------------------------
+    def _map_file_native(self, itask, filename, kv, ptr):
         with open(filename, "rb") as f:
             data = np.frombuffer(f.read(), dtype=np.uint8)
         doc_id = len(self.docs)
         self.docs.append(filename)
         if len(data) == 0:
             return
-        if self.engine == "native":
-            with self.timer.stage("native_scan"):
-                starts, lengths = native.find_hrefs(data)
-            # device path drops URLs with no terminator within MAX_URL;
-            # match that instead of silently truncating
-            lengths = np.where(lengths > MAX_URL, -1, lengths)
-        else:
-            starts, lengths = _device_extract(data, self.use_pallas,
-                                              self.interpret, self.timer)
+        with self.timer.stage("native_scan"):
+            starts, lengths = native.find_hrefs(data)
+        # device path drops URLs whose terminator is not WITHIN its
+        # MAX_URL-byte window (max representable length MAX_URL-1); match
+        # that instead of silently truncating
+        lengths = np.where(lengths >= MAX_URL, -1, lengths)
         with self.timer.stage("host_add"):
             keep = lengths >= 0  # unterminated href: reference runs off; we drop
             urls = [data[st:st + ln].tobytes()
                     for st, ln in zip(starts[keep], lengths[keep])]
-            ids = hash_bytes64_batch(urls)  # native C++ batch intern
-            for h, url in zip(ids.tolist(), urls):
-                prev = self.urls.get(h)
-                if prev is not None and prev != url:
-                    raise ValueError(
-                        f"64-bit URL intern collision: {prev!r} vs {url!r}")
-                self.urls[h] = url
+            ids = hash_bytes64_batch(urls)
+            self._intern(ids, urls)
             kv.add_batch(ids, np.full(len(ids), doc_id, dtype=np.uint32))
+
+    def _intern(self, ids, urls):
+        for h, url in zip(ids.tolist(), urls):
+            prev = self.urls.get(h)
+            if prev is not None and prev != url:
+                raise ValueError(
+                    f"64-bit URL intern collision: {prev!r} vs {url!r}")
+            self.urls[h] = url
+
+    # -- map stage: fused device tier -------------------------------------
+    _BATCH_BYTES = 1 << 30   # per-corpus cap: byte offsets are int32
+
+    def _file_batches(self, files):
+        """Greedy contiguous file batches under the int32 corpus cap (the
+        reference likewise works per-process file batches,
+        cuda/InvertedIndex.cu:284-287)."""
+        batches, cur, size = [], [], 0
+        for f in files:
+            fsz = os.path.getsize(f) + _GAP
+            if fsz > self._BATCH_BYTES:
+                raise ValueError(
+                    f"{f}: single file of {fsz} bytes exceeds the device "
+                    f"corpus cap ({self._BATCH_BYTES})")
+            if cur and size + fsz > self._BATCH_BYTES:
+                batches.append(cur)
+                cur, size = [], 0
+            cur.append(f)
+            size += fsz
+        if cur:
+            batches.append(cur)
+        return batches
+
+    def _map_corpus_device(self, files, kv, want_urls: bool):
+        self.docs = list(files)
+        mesh1 = self._single_device_mesh()
+        parts = []          # per batch: (ids, alts, docs, npairs) device
+        corpora = []        # per batch: (corpus, ustarts, lengths, ids)
+        doc_base = 0
+        keep_bytes = want_urls or sum(
+            os.path.getsize(f) for f in files) <= URL_DICT_MAX
+        for batch in self._file_batches(files):
+            with self.timer.stage("read"):
+                corpus, fstarts = _build_corpus(batch)
+            if len(corpus) == 0:
+                doc_base += len(batch)
+                continue
+            with self.timer.stage("h2d"):
+                words = jax.device_put(jnp.asarray(bytes_view_u32(corpus)))
+                fstarts_d = jax.device_put(jnp.asarray(fstarts))
+                jax.block_until_ready(words)
+
+            cap = max(8, 1 << (max(1, len(corpus) // 512) - 1).bit_length())
+            with self.timer.stage("map_device"):
+                while True:
+                    fn = _extract_fn(cap, self.use_pallas, self.interpret)
+                    ids, alts, docs, ustarts, lengths, nhits, npairs = fn(
+                        words, fstarts_d)
+                    nhits, npairs = map(int, jax.device_get((nhits, npairs)))
+                    if nhits <= cap:
+                        break
+                    cap = max(8, 1 << (nhits - 1).bit_length())  # retry
+                if doc_base:
+                    docs = docs + np.uint32(doc_base)
+            parts.append((ids, alts, docs, npairs))
+            if keep_bytes:
+                corpora.append((corpus, ustarts, lengths, ids, npairs))
+            doc_base += len(batch)
+
+        if not parts:
+            return
+        with self.timer.stage("map_device"):
+            ids, alts, docs, npairs = _assemble_parts(parts)
+            if mesh1 is not None:
+                # zero-copy into the sharded KV: the packed device columns
+                # ARE the shard (P=1; capacity is a power of two >= 8);
+                # aggregate/convert/reduce stay on device
+                from ..parallel.sharded import ShardedKV
+                kv.add_frame(ShardedKV(mesh1, ids, docs,
+                                       np.array([npairs], np.int32)))
+                ncoll = int(_collision_check_fn()(
+                    ids, alts, jnp.int32(npairs)))
+            else:
+                ids_h = np.asarray(ids[:npairs])
+                alts_h = np.asarray(alts[:npairs])
+                kv.add_batch(ids_h, np.asarray(docs[:npairs]))
+                order = np.lexsort((alts_h, ids_h))
+                a, b = ids_h[order], alts_h[order]
+                ncoll = int(((a[1:] == a[:-1]) & (b[1:] != b[:-1])).sum())
+            if ncoll:
+                raise ValueError(
+                    f"{ncoll} 64-bit URL intern collision(s) detected "
+                    f"(distinct URLs share a u64 id)")
+
+        if keep_bytes:
+            with self.timer.stage("url_dict"):
+                for corpus, ustarts, lengths, bids, n in corpora:
+                    st, ln, idh = (np.asarray(ustarts[:n]),
+                                   np.asarray(lengths[:n]),
+                                   np.asarray(bids[:n]))
+                    urls = [corpus[s:s + l].tobytes()
+                            for s, l in zip(st.tolist(), ln.tolist())]
+                    self._intern(idh, urls)
+
+    def _single_device_mesh(self):
+        from ..parallel.backend import MeshBackend
+        mr = getattr(self, "_mr", None)
+        if (mr is not None and isinstance(mr.backend, MeshBackend)
+                and mr.backend.nprocs == 1):
+            return mr.backend.mesh
+        return None
 
     # -- full pipeline ---------------------------------------------------
     def run(self, paths: Sequence[str], outdir: Optional[str] = None,
@@ -204,11 +362,17 @@ class InvertedIndex:
         to outdir/part-<proc> when outdir is given (reference myreduce,
         cuda/InvertedIndex.cu:463-513)."""
         mr = MapReduce(self.comm)
+        self._mr = mr
         files = findfiles(list(paths))
         if nfiles is not None:
             files = files[:nfiles]
         with self.timer.stage("map"):
-            self.npairs = mr.map_files(files, self._map_file)
+            if self.engine == "native":
+                self.npairs = mr.map_files(files, self._map_file_native)
+            else:
+                self.npairs = mr.map(
+                    1, lambda itask, kv, ptr: self._map_corpus_device(
+                        files, kv, want_urls=outdir is not None))
         with self.timer.stage("aggregate"):
             mr.aggregate()
         with self.timer.stage("convert"):
@@ -217,7 +381,7 @@ class InvertedIndex:
         out = None
         nurl = [0]
 
-        def emit(key, values, kv, ptr):
+        def emit_host(key, values, kv, ptr):
             nurl[0] += 1
             if out is not None:
                 url = self.urls[int(key)].decode(errors="replace")
@@ -225,14 +389,31 @@ class InvertedIndex:
                 out.write(f"{url}\t{names}\n")
             kv.add(key, len(values))
 
+        def emit_batch(fr, kv, ptr):
+            # device tier: vectorised count per group, no host round trip
+            from ..parallel.group import reduce_sharded
+            counted = reduce_sharded(fr, "count")
+            nurl[0] += len(counted)
+            kv.add_frame(counted)
+
         try:
             if outdir:
                 os.makedirs(outdir, exist_ok=True)
                 out = open(os.path.join(outdir, "part-00000"), "w")
             with self.timer.stage("reduce"):
-                mr.reduce(emit)
+                device_tier = (out is None and self.kmv_is_sharded(mr))
+                if device_tier:
+                    mr.reduce(emit_batch, batch=True)
+                else:
+                    mr.reduce(emit_host)
         finally:
             if out is not None:
                 out.close()
         self.mr = mr
         return self.npairs, nurl[0]
+
+    @staticmethod
+    def kmv_is_sharded(mr) -> bool:
+        from ..core.frame import KMVFrame
+        return (mr.kmv is not None
+                and any(not isinstance(f, KMVFrame) for f in mr.kmv.frames()))

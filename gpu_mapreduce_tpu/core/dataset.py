@@ -352,6 +352,24 @@ class KeyMultiValue:
 
 # ---------------------------------------------------------------------------
 
+def rows_to_array(rows: list) -> np.ndarray:
+    """np.asarray for scalar/tuple rows that REFUSES numpy's silent
+    int→float64 fallback: a python-int list straddling 2^63 (u64 hash ids
+    next to small counts) coerces to lossy float64 — here it becomes exact
+    uint64 instead."""
+    arr = np.asarray(rows)
+
+    def _u64able(e):
+        return isinstance(e, (int, np.integer)) and 0 <= int(e) < (1 << 64)
+
+    if (arr.dtype == np.float64
+            and all(_u64able(r) or
+                    (isinstance(r, tuple) and all(_u64able(e) for e in r))
+                    for r in rows)):
+        arr = np.asarray(rows, dtype=np.uint64)
+    return arr
+
+
 def _coerce_rows(rows: list) -> Column:
     """Turn a python append buffer into a column: bytes→BytesColumn,
     numbers/tuples→DenseColumn."""
@@ -362,7 +380,7 @@ def _coerce_rows(rows: list) -> Column:
                             for r in rows])
     if first is None:
         return DenseColumn(np.zeros(len(rows), dtype=np.uint8))
-    arr = np.asarray(rows)
+    arr = rows_to_array(rows)
     if arr.dtype == object:
         raise TypeError("mixed-type rows in KV add buffer")
     return DenseColumn(arr)

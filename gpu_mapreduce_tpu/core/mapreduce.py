@@ -625,7 +625,8 @@ def _rows_to_column(rows: list) -> Column:
     if isinstance(first, (bytes, str)):
         return BytesColumn([r.encode() if isinstance(r, str) else r
                             for r in rows])
-    return DenseColumn(np.asarray(rows))
+    from .dataset import rows_to_array
+    return DenseColumn(rows_to_array(rows))
 
 
 def _interleave_rows(rows: list, error: Error) -> Column:
@@ -638,7 +639,8 @@ def _interleave_rows(rows: list, error: Error) -> Column:
     if any(isinstance(r, (bytes, str)) for r in rows):
         error.all("collapse requires keys and values of a common type "
                   "(all bytes or all numeric)")
-    arr = np.asarray(rows)
+    from .dataset import rows_to_array
+    arr = rows_to_array(rows)
     if arr.dtype == object:
         error.all("collapse requires keys and values of a common shape")
     return DenseColumn(arr)

@@ -180,6 +180,93 @@ def hash_words32(words, initval: int = 0):
     return c
 
 
+def hashlittle_masked(words, lengths, initval: int = 0):
+    """Vectorised hashlittle over VARIABLE-length byte strings.
+
+    ``words``: uint32 array [..., T] — each row a key's bytes as
+    little-endian u32 words, **zeroed beyond its length** (lookup3's tail
+    handling pads with zero bytes, so pre-zeroed words reproduce it
+    exactly).  ``lengths``: int32 byte lengths [...].  Returns uint32 [...]
+    bit-identical to :func:`hashlittle` on each row's exact bytes.
+
+    The reference hashes raw variable-length key bytes on the host
+    (src/hash.cpp:104-228); this is the device twin that lets string-keyed
+    workloads (URLs, words) intern to u64 ids *on chip* instead of in a
+    host loop.  The 12-byte-block loop is unrolled over the static word
+    width T: each row applies mix() while >12 bytes remain, then one
+    final() at its own tail block, selected by masks — no data-dependent
+    control flow, so it fuses into surrounding kernels.
+    """
+    xp = jnp if (jnp is not None and not isinstance(words, np.ndarray)) else np
+    words = words.astype(np.uint32)
+    T = words.shape[-1]
+    pad = (-T) % 3
+    if pad:
+        zshape = words.shape[:-1] + (pad,)
+        words = xp.concatenate([words, xp.zeros(zshape, np.uint32)], axis=-1)
+        T += pad
+    lengths = lengths.astype(np.uint32)
+    init = (np.uint32((0xDEADBEEF + initval) & _M32) + lengths)
+    a = b = c = init
+    out = init  # length==0 rows: hashlittle returns c == init
+    lengths_i = lengths.astype(np.int32)
+
+    def step(t, a, b, c, out, w0, w1, w2):
+        rem = lengths_i - np.int32(12) * t
+        is_full = rem > 12          # another 12-byte block follows → mix
+        is_tail = (rem > 0) & (rem <= 12)   # this block is the tail → final
+        a0, b0, c0 = a + w0, b + w1, c + w2
+        am, bm, cm = _jmix(a0, b0, c0)
+        _, _, cf = _jfinal(a0, b0, c0)
+        return (xp.where(is_full, am, a), xp.where(is_full, bm, b),
+                xp.where(is_full, cm, c), xp.where(is_tail, cf, out))
+
+    nblocks = T // 3
+    if xp is np or nblocks <= 8:
+        # short keys / numpy: unrolled (XLA fuses a short chain fine)
+        for t in range(nblocks):
+            a, b, c, out = step(np.int32(t), a, b, c, out,
+                                words[..., 3 * t], words[..., 3 * t + 1],
+                                words[..., 3 * t + 2])
+        return out
+
+    # long keys under jit: a fori_loop keeps the compiled program O(1) in
+    # key width — the fully unrolled 80+-step mix/final chain stalls XLA's
+    # CPU backend for minutes and bloats the TPU program for no speedup
+    # (the loop body is pure VPU work; 80 trips of ~40 vector ops is
+    # nothing next to the gathers around it)
+    import jax as _jax
+
+    def body(t, carry):
+        a, b, c, out = carry
+        w = _jax.lax.dynamic_slice_in_dim(words, 3 * t, 3, axis=-1)
+        return step(t.astype(np.int32), a, b, c, out,
+                    w[..., 0], w[..., 1], w[..., 2])
+
+    a, b, c, out = _jax.lax.fori_loop(0, nblocks, body, (a, b, c, out))
+    return out
+
+
+def hash_bytes64_masked(words, lengths, seed_hi: int = 0,
+                        seed_lo: int = 0xDEADBEEF):
+    """Device twin of :func:`hash_bytes64`: u64 intern id from two seeded
+    masked-hashlittle passes.  With the default seeds, bit-identical to the
+    host/native intern on the same byte strings — device- and host-produced
+    ids interoperate.  Alternate seeds give an INDEPENDENT id family (used
+    to detect 64-bit intern collisions without the byte strings)."""
+    hi = hashlittle_masked(words, lengths, seed_hi).astype(np.uint64)
+    lo = hashlittle_masked(words, lengths, seed_lo).astype(np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+def bytes_to_words32(buf: np.ndarray, max_len: int) -> np.ndarray:
+    """Host helper: [n, max_len] u8 rows (zero-padded) → [n, max_len/4] u32
+    little-endian words for the masked hash functions."""
+    assert max_len % 4 == 0
+    return np.ascontiguousarray(buf[..., :max_len]).view(
+        np.dtype("<u4")).reshape(buf.shape[0], max_len // 4)
+
+
 def hash_u64(keys, initval: int = 0):
     """Hash an array of uint64 keys → uint32, matching hashlittle on their
     8-byte little-endian encodings (the aggregate() partition hash applied to

@@ -303,6 +303,13 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     from ..core.runtime import Timer
     kv = mr.kv
     frame = kv.one_frame()
+    if mesh_axis_size(backend.mesh) == 1:
+        # reference early-out for nprocs==1 (src/mapreduce.cpp:403-406):
+        # no exchange — but a dense host frame still moves onto the device
+        # so convert/reduce run the sharded (device) tier
+        if isinstance(frame, KVFrame) and frame.is_dense():
+            _replace_kv_frames(kv, shard_frame(frame, backend.mesh))
+        return
     if isinstance(frame, KVFrame):
         if not frame.is_dense():
             mr.error.warning(

@@ -133,3 +133,51 @@ def test_pipeline_on_mesh(html_corpus):
     ii2 = InvertedIndex(comm=make_mesh())
     n2 = ii2.run(html_corpus)
     assert n1 == n2
+
+
+def test_multi_batch_corpus(html_corpus, monkeypatch):
+    """Force the per-corpus byte cap below one file so every file becomes
+    its own batch — counts and url dict must match the single-batch run."""
+    ii1 = InvertedIndex()
+    n1 = ii1.run(html_corpus)
+    monkeypatch.setattr(InvertedIndex, "_BATCH_BYTES", 4096)
+    ii2 = InvertedIndex()
+    n2 = ii2.run(html_corpus)
+    assert n1 == n2
+    assert ii1.urls == ii2.urls
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    ii3 = InvertedIndex(comm=make_mesh(1))
+    n3 = ii3.run(html_corpus)
+    assert n3 == n1
+
+
+def test_single_file_over_cap_raises(tmp_path, monkeypatch):
+    p = tmp_path / "big.html"
+    p.write_bytes(b"x" * 8192)
+    monkeypatch.setattr(InvertedIndex, "_BATCH_BYTES", 4096)
+    with pytest.raises(ValueError, match="exceeds the device corpus cap"):
+        InvertedIndex().run([str(p)])
+
+
+def test_pipeline_on_single_device_mesh(html_corpus):
+    """The bench's actual tier: P=1 mesh → zero-copy ShardedKV from the
+    fused extract, aggregate early-out, device convert, batch count
+    reduce (emit_batch) — must agree with the serial path."""
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.parallel.sharded import ShardedKV
+
+    ii1 = InvertedIndex()
+    n1 = ii1.run(html_corpus)
+    ii2 = InvertedIndex(comm=make_mesh(1))
+    n2 = ii2.run(html_corpus)
+    assert n1 == n2
+    # the reduced KV must still be device-resident (count per url id)
+    fr = ii2.mr.kv.one_frame()
+    assert isinstance(fr, ShardedKV)
+    import numpy as np
+    counts = {int(k): int(v) for k, v in fr.to_host().pairs()}
+    ref = {}
+    for k, vals in (
+            lambda m: m)(ii1.mr.kv.one_frame()).pairs():
+        ref[int(k)] = int(vals)
+    assert counts == ref

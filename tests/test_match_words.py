@@ -1,0 +1,174 @@
+"""Word-packed mark kernel + unaligned-window + masked-hash primitives
+(the round-2 fused map stage) vs byte-level oracles."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gpu_mapreduce_tpu.apps.invertedindex import PATTERN
+from gpu_mapreduce_tpu.ops.hash import (bytes_to_words32, hash_bytes64,
+                                        hash_bytes64_masked, hashlittle,
+                                        hashlittle_masked)
+from gpu_mapreduce_tpu.ops.pallas.match import (bytes_view_u32,
+                                                compact_word_matches,
+                                                first_byte_pos, mark_xla,
+                                                mark_words_pallas,
+                                                mark_words_xla,
+                                                mask_words_to_length,
+                                                unaligned_words)
+
+
+def _planted_buffer(rng, n, offsets):
+    buf = rng.integers(0, 256, n, dtype=np.uint8)
+    for off in offsets:
+        buf[off:off + len(PATTERN)] = np.frombuffer(PATTERN, np.uint8)
+    return buf
+
+
+def _byte_oracle(buf):
+    """Ground-truth match starts from python bytes.find."""
+    data = buf.tobytes()
+    out, start = [], 0
+    while True:
+        i = data.find(PATTERN, start)
+        if i < 0:
+            return np.array(out, np.int64)
+        out.append(i)
+        start = i + 1
+
+
+@pytest.mark.parametrize("offsets", [
+    (0,), (1,), (2,), (3,),                     # every word alignment
+    (508, 1020, 131067),                        # crossing lane/row/block edges
+    (5, 1000, 131072 * 4 - 20),
+])
+def test_mark_words_pallas_vs_oracle(rng, offsets):
+    n = 131072 * 4 + 64
+    buf = _planted_buffer(rng, n, offsets)
+    words = jnp.asarray(bytes_view_u32(buf))
+    wm_k = np.asarray(mark_words_pallas(words, PATTERN, interpret=True))
+    wm_x = np.asarray(mark_words_xla(words, PATTERN))
+    np.testing.assert_array_equal(wm_k, wm_x)
+    starts, cnt = compact_word_matches(jnp.asarray(wm_k), n, 64)
+    st = np.asarray(starts)
+    st = np.sort(st[st < n])
+    oracle = _byte_oracle(buf)
+    np.testing.assert_array_equal(st, oracle)
+    assert int(cnt) == len(oracle)
+
+
+def test_word_mask_agrees_with_byte_mask(rng):
+    buf = _planted_buffer(rng, 4096, (7, 130, 1001))
+    words = jnp.asarray(bytes_view_u32(buf))
+    wm = np.asarray(mark_words_xla(words, PATTERN))
+    bm = np.asarray(mark_xla(jnp.asarray(buf), PATTERN))
+    for i, v in enumerate(wm.tolist()):
+        if v:
+            assert bm[4 * i + v - 1] == 1
+    assert (wm > 0).sum() == bm.sum()
+
+
+def test_min_period_guard():
+    with pytest.raises(ValueError):
+        mark_words_xla(jnp.zeros(8, jnp.uint32), b"aaa")
+
+
+def test_unaligned_words_every_alignment(rng):
+    data = rng.integers(0, 256, 256, dtype=np.uint8)
+    words = jnp.asarray(bytes_view_u32(data))
+    for s in (0, 1, 2, 3, 17, 100):
+        win = np.asarray(unaligned_words(words, jnp.asarray([s], np.int32), 8))
+        want = np.zeros(32, np.uint8)
+        take = data[s:s + 32]
+        want[:len(take)] = take
+        np.testing.assert_array_equal(
+            win[0], want.view("<u4"), err_msg=f"start={s}")
+
+
+def test_unaligned_words_out_of_range_zero():
+    words = jnp.asarray(np.full(4, 0xFFFFFFFF, np.uint32))
+    win = np.asarray(unaligned_words(words, jnp.asarray([14, 99], np.int32), 4))
+    assert win[0, 0] == 0xFFFF          # last 2 real bytes, then zeros
+    assert (win[0, 1:] == 0).all()
+    assert (win[1] == 0).all()          # fully out of range
+
+
+def test_first_byte_pos_and_mask(rng):
+    rows = np.array([
+        b'abc"xxxxxxxx',     # quote at 3
+        b'"aaaaaaaaaaa',      # quote at 0
+        b'nothing-here',      # none
+    ])
+    arr = np.frombuffer(b"".join(rows), np.uint8).reshape(3, 12)
+    pad = np.zeros((3, 4), np.uint8)
+    wu = jnp.asarray(np.concatenate([arr, pad], 1).view("<u4"))
+    pos = np.asarray(first_byte_pos(wu, ord('"')))
+    np.testing.assert_array_equal(pos, [3, 0, -1])
+    masked = np.asarray(mask_words_to_length(
+        wu, jnp.asarray([3, 0, 5], np.int32)))
+    b = masked.view(np.uint32)
+    # row 0: bytes 0..2 kept, rest zero
+    np.testing.assert_array_equal(
+        masked[0].view("<u4"), np.frombuffer(b"abc" + b"\0" * 13, "<u4"))
+    assert (masked[1] == 0).all()
+
+
+def test_masked_hash_matches_scalar(rng):
+    maxl = 48
+    lens = rng.integers(0, maxl + 1, 64).astype(np.int32)
+    rows = np.zeros((64, maxl), np.uint8)
+    strs = []
+    for i, l in enumerate(lens):
+        s = rng.integers(1, 256, l, dtype=np.uint8).tobytes()
+        strs.append(s)
+        rows[i, :l] = np.frombuffer(s, np.uint8)
+    words = bytes_to_words32(rows, maxl)
+    want32 = np.array([hashlittle(s) for s in strs], np.uint32)
+    want64 = np.array([hash_bytes64(s) for s in strs], np.uint64)
+    np.testing.assert_array_equal(hashlittle_masked(words, lens), want32)
+    np.testing.assert_array_equal(hash_bytes64_masked(words, lens), want64)
+    # jit path (fori_loop branch kicks in over 8 blocks → use wide rows too)
+    got = np.asarray(jax.jit(hash_bytes64_masked)(
+        jnp.asarray(words), jnp.asarray(lens)))
+    np.testing.assert_array_equal(got, want64)
+
+
+def test_masked_hash_wide_fori_branch(rng):
+    maxl = 256  # 64 words → fori_loop path under jit
+    lens = rng.integers(0, maxl + 1, 16).astype(np.int32)
+    rows = np.zeros((16, maxl), np.uint8)
+    strs = []
+    for i, l in enumerate(lens):
+        s = rng.integers(1, 256, l, dtype=np.uint8).tobytes()
+        strs.append(s)
+        rows[i, :l] = np.frombuffer(s, np.uint8)
+    words = bytes_to_words32(rows, maxl)
+    want = np.array([hash_bytes64(s) for s in strs], np.uint64)
+    got = np.asarray(jax.jit(hash_bytes64_masked)(
+        jnp.asarray(words), jnp.asarray(lens)))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_device_ids_match_native_intern(tmp_path, rng):
+    """The fused device path and the native C++ host path must produce the
+    SAME u64 url ids (ops/hash.py contract) — checked end-to-end."""
+    from gpu_mapreduce_tpu import native
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+    files = []
+    for fi in range(2):
+        parts = []
+        for u in range(5):
+            parts.append(b'<a href="http://h%d/u%d">x</a>pad' % (fi, u))
+        p = tmp_path / f"f{fi}.html"
+        p.write_bytes(b"".join(parts))
+        files.append(str(p))
+    ii_dev = InvertedIndex(engine="pallas")
+    ii_dev.run(files)
+    if not native.available():
+        pytest.skip("no native toolchain")
+    ii_nat = InvertedIndex(engine="native")
+    ii_nat.run(files)
+    assert ii_dev.urls == ii_nat.urls
+    assert set(ii_dev.urls.keys()) == set(ii_nat.urls.keys())
