@@ -207,14 +207,22 @@ class KeyValue:
 
     def one_frame(self):
         """Whole dataset as a single frame (in-core fast path).  Returns the
-        ShardedKV directly when that's the sole frame; a mixed plain+sharded
-        dataset compacts to host first."""
+        ShardedKV directly when that's the sole frame; several sharded
+        frames on one mesh concatenate per-shard ON DEVICE (the add() path
+        of iterative mesh commands); a mixed plain+sharded dataset compacts
+        to host."""
         frames = list(self.frames())
         if not frames:
             from .frame import empty_kv
             return empty_kv()
         if len(frames) == 1:
             return frames[0]
+        from ..parallel.sharded import ShardedKV
+        if all(isinstance(f, ShardedKV) for f in frames) \
+                and len({f.mesh for f in frames}) == 1:
+            import functools as _ft
+            from ..parallel.devkernels import concat_sharded
+            return _ft.reduce(concat_sharded, frames)
         frames = [f if isinstance(f, KVFrame) else f.to_host() for f in frames]
         return _merge_frames(frames)
 

@@ -303,14 +303,17 @@ class MapReduce:
 
     def clone(self) -> int:
         """KV→KMV, each pair its own 1-value group (reference
-        src/mapreduce.cpp:631-652)."""
+        src/mapreduce.cpp:631-652).  Sharded input clones per shard on
+        device (row i ⇒ group i of size 1)."""
         kv = self._require_kv("clone")
         fr = kv.one_frame()
         if not isinstance(fr, KVFrame):
-            fr = fr.to_host()
-        n = len(fr)
-        kmv_frame = KMVFrame(fr.key, np.ones(n, np.int64),
-                             np.arange(n + 1, dtype=np.int64), fr.value)
+            from ..parallel.devkernels import clone_sharded
+            kmv_frame = clone_sharded(fr)
+        else:
+            n = len(fr)
+            kmv_frame = KMVFrame(fr.key, np.ones(n, np.int64),
+                                 np.arange(n + 1, dtype=np.int64), fr.value)
         kv.free()
         self.kv = None
         self.kmv = self._new_kmv()

@@ -25,27 +25,72 @@ from ..kernels import (count, edge_to_vertices, host_kmv, invert, kmv_keys,
 
 
 # ---------------------------------------------------------------------------
-# batch kernels (reference cc_find.cpp:129-260 callbacks, vectorised)
+# batch kernels (reference cc_find.cpp:129-260 callbacks, vectorised).
+# Each has a host body (KVFrame/KMVFrame) and a device body (per-shard
+# jittable under shard_map, parallel/devkernels.py) — on the mesh backend a
+# whole cc iteration runs shuffle → segment ops → emit entirely in HBM.
 # ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from ...parallel.devkernels import (U64MAX, is_sharded_kmv, is_sharded_kv,
+                                    kmv_row_state, seg_max_u64, seg_min_u64,
+                                    skmv_map, skv_map)
+
+
+def _u64z(n):
+    return jnp.zeros(n, jnp.uint64)
+
+
+def _self_zone_dev(uk, nv, vo, vals, gc, vc):
+    valid = jnp.arange(uk.shape[0]) < gc
+    return uk, uk, valid
+
 
 def self_zone(fr, kv, ptr):
     """V:[..] group → V:V — every vertex starts in its own zone
     (reduce_self_zone, cc_find.cpp:132-137)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _self_zone_dev))
+        return
     k = kmv_keys(fr)
     kv.add_batch(k, k)
+
+
+def _edge_vert_tagged_dev(k, v, c):
+    n = k.shape[0]
+    valid = jnp.arange(n) < c
+    tag0 = jnp.stack([_u64z(n), k[:, 0], k[:, 1]], 1)
+    okey = jnp.concatenate([k[:, 0], k[:, 1]])
+    oval = jnp.concatenate([tag0, tag0])
+    return okey, oval, jnp.concatenate([valid, valid])
 
 
 def edge_vert_tagged(fr, kv, ptr):
     """Eij:NULL → Vi:[0,vi,vj] and Vj:[0,vi,vj] (map_edge_vert,
     cc_find.cpp:141-148, tagged instead of sized)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _edge_vert_tagged_dev))
+        return
     e = kv_keys(fr)
     val = np.concatenate([
         np.stack([np.zeros(len(e), np.uint64), e[:, 0], e[:, 1]], 1)] * 2)
     kv.add_batch(np.concatenate([e[:, 0], e[:, 1]]), val)
 
 
+def _zone_tagged_dev(k, v, c):
+    n = k.shape[0]
+    valid = jnp.arange(n) < c
+    oval = jnp.stack([jnp.ones(n, jnp.uint64), v.astype(jnp.uint64),
+                      _u64z(n)], 1)
+    return k, oval, valid
+
+
 def zone_tagged(fr, kv, ptr):
     """V:zone → V:[1,zone,0] (the mrv contribution to the join)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _zone_tagged_dev))
+        return
     k = kv_keys(fr)
     z = kv_values(fr)
     zeros = np.zeros(len(k), np.uint64)
@@ -53,9 +98,22 @@ def zone_tagged(fr, kv, ptr):
                               z.astype(np.uint64), zeros], 1))
 
 
+def _edge_zone_dev(uk, nv, vo, vals, gc, vc):
+    gcap = uk.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    is_zone = vals[:, 0] == 1
+    zone_of = seg_max_u64(vals[:, 1], seg, rows_valid & is_zone, gcap)
+    okey = vals[:, 1:3]
+    oval = jnp.take(zone_of, jnp.maximum(seg, 0))
+    return okey, oval, rows_valid & ~is_zone
+
+
 def edge_zone(fr, kv, ptr):
     """Per-vertex group: find the zone row, emit (Eij : zone) per edge row
     (reduce_edge_zone, cc_find.cpp:152-186)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _edge_zone_dev))
+        return
     fr = host_kmv(fr)
     vals = kmv_values(fr)                      # [n, 3] tagged
     seg = seg_ids(fr)
@@ -66,10 +124,22 @@ def edge_zone(fr, kv, ptr):
     kv.add_batch(vals[is_edge, 1:3], zone_of[seg[is_edge]])
 
 
+def _zone_winner_dev(uk, nv, vo, vals, gc, vc):
+    gcap = uk.shape[0]
+    seg, rows_valid, groups_valid = kmv_row_state(nv, vo, vals, gc, vc)
+    zmin = seg_min_u64(vals, seg, rows_valid, gcap)
+    zmax = seg_max_u64(vals, seg, rows_valid, gcap)
+    changed = groups_valid & (zmin != zmax)
+    return zmax, zmin, changed
+
+
 def zone_winner(fr, kv, ptr):
     """Per-edge group of zone ids: if the two endpoint zones differ, emit
     (loser_zone : winner_zone), winner = min (reduce_zone_winner,
     cc_find.cpp:190-219).  Emits nothing when converged."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _zone_winner_dev))
+        return
     fr = host_kmv(fr)
     vals = kmv_values(fr).astype(np.uint64)    # [n] zone per edge copy
     zmin = np.minimum.reduceat(vals, fr.offsets[:-1])
@@ -78,27 +148,62 @@ def zone_winner(fr, kv, ptr):
     kv.add_batch(zmax[changed], zmin[changed])
 
 
+def _invert_zone_tagged_dev(k, v, c):
+    n = k.shape[0]
+    valid = jnp.arange(n) < c
+    oval = jnp.stack([_u64z(n), k, _u64z(n)], 1)
+    return v.astype(jnp.uint64), oval, valid
+
+
 def invert_zone_tagged(fr, kv, ptr):
     """V:zone → zone:[0,v,0] — membership rows for reassignment
     (map_invert_multi, cc_find.cpp:223-238, without the hi-bit split)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _invert_zone_tagged_dev))
+        return
     k = kv_keys(fr)
     z = kv_values(fr).astype(np.uint64)
     zeros = np.zeros(len(k), np.uint64)
     kv.add_batch(z, np.stack([zeros, k, zeros], 1))
 
 
+def _winner_tagged_dev(k, v, c):
+    n = k.shape[0]
+    valid = jnp.arange(n) < c
+    oval = jnp.stack([jnp.ones(n, jnp.uint64), v.astype(jnp.uint64),
+                      _u64z(n)], 1)
+    return k, oval, valid
+
+
 def winner_tagged(fr, kv, ptr):
     """loser_zone:winner → loser_zone:[1,winner,0] (map_zone_multi,
     cc_find.cpp:242-...)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _winner_tagged_dev))
+        return
     k = kv_keys(fr)
     w = kv_values(fr).astype(np.uint64)
     zeros = np.zeros(len(k), np.uint64)
     kv.add_batch(k, np.stack([np.ones(len(k), np.uint64), w, zeros], 1))
 
 
+def _zone_reassign_dev(uk, nv, vo, vals, gc, vc):
+    gcap = uk.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    is_win = vals[:, 0] == 1
+    win_zone = seg_min_u64(vals[:, 1], seg, rows_valid & is_win, gcap)
+    new_zone = jnp.where(win_zone != U64MAX, win_zone, uk)
+    okey = vals[:, 1]
+    oval = jnp.take(new_zone, jnp.maximum(seg, 0))
+    return okey, oval, rows_valid & ~is_win
+
+
 def zone_reassign(fr, kv, ptr):
     """Per-zone group: members move to min winner zone if any winner row
     present, else stay (reduce_zone_reassign)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _zone_reassign_dev))
+        return
     fr = host_kmv(fr)
     vals = kmv_values(fr)                      # [n, 3]
     seg = seg_ids(fr)
@@ -137,6 +242,8 @@ class CCFind(Command):
     def run(self):
         obj = self.obj
         mre = obj.input(1, read_edge)
+        mre.aggregate()   # mesh: shard the edge list once; every iteration
+        #                   below then stays device-resident (serial: no-op)
         mrv = obj.create_mr()
 
         mrv.map_mr(mre, edge_to_vertices, batch=True)

@@ -57,12 +57,95 @@ def vertex_rand(v: np.ndarray, seed: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# round kernels (batch reduces)
+# round kernels (batch reduces).  Host bodies below; device bodies (per
+# shard, jitted under shard_map) alongside — the mesh backend never pulls
+# a frame to the controller inside the round loop.
 # ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from ...parallel.devkernels import (is_sharded_kmv, is_sharded_kv,
+                                    kmv_row_state, seg_max_u64, skmv_map,
+                                    skv_map)
+
+
+def _vertex_rand_dev(v, seed: int):
+    """jnp twin of vertex_rand — identical splitmix64 bits."""
+    x = v.astype(jnp.uint64) + jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF)
+    x = x + jnp.uint64(0x9E3779B97F4A7C15)
+    z = x
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> jnp.uint64(31))
+    return (z >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
+
+
+def _seg_any(cond, seg, valid, gcap):
+    return seg_max_u64(cond.astype(jnp.uint64), seg, valid, gcap) > 0
+
+
+def _edge_winner_dev(uk, nv, vo, vals, gc, vc, seed):
+    gcap = uk.shape[0]
+    seg, rows_valid, groups_valid = kmv_row_state(nv, vo, vals, gc, vc)
+    flag = vals if vals.ndim == 1 else vals[:, 0]
+    dead = _seg_any(flag != 0, seg, rows_valid, gcap)
+    alive = groups_valid & ~dead
+    ri = _vertex_rand_dev(uk[:, 0], seed)
+    rj = _vertex_rand_dev(uk[:, 1], seed)
+    vi_wins = (ri < rj) | ((ri == rj) & (uk[:, 0] < uk[:, 1]))
+    w = jnp.where(vi_wins, uk[:, 0], uk[:, 1])
+    l = jnp.where(vi_wins, uk[:, 1], uk[:, 0])
+    one = jnp.ones(gcap, jnp.uint64)
+    okey = jnp.concatenate([w, l])
+    oval = jnp.concatenate([jnp.stack([l, one], 1),
+                            jnp.stack([w, one - 1], 1)])
+    return okey, oval, jnp.concatenate([alive, alive])
+
+
+def _vert_winner_dev(uk, nv, vo, vals, gc, vc):
+    gcap = uk.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    lost_any = _seg_any(vals[:, 1] == 0, seg, rows_valid, gcap)
+    tag = (~jnp.take(lost_any, jnp.maximum(seg, 0))).astype(jnp.uint64)
+    okey = vals[:, 0]
+    oval = jnp.stack([jnp.take(uk, jnp.maximum(seg, 0)), tag], 1)
+    return okey, oval, rows_valid
+
+
+def _vert_loser_dev(uk, nv, vo, vals, gc, vc):
+    gcap = uk.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    loser = _seg_any(vals[:, 1] == 1, seg, rows_valid, gcap)
+    tag = jnp.take(loser, jnp.maximum(seg, 0)).astype(jnp.uint64)
+    okey = vals[:, 0]
+    oval = jnp.stack([jnp.take(uk, jnp.maximum(seg, 0)), tag], 1)
+    return okey, oval, rows_valid
+
+
+def _vert_emit_mis_dev(uk, nv, vo, vals, gc, vc):
+    """Per-group: all neighbours losers ⇒ group key joins the MIS."""
+    gcap = uk.shape[0]
+    seg, rows_valid, groups_valid = kmv_row_state(nv, vo, vals, gc, vc)
+    survivor_nb = _seg_any(vals[:, 1] == 0, seg, rows_valid, gcap)
+    mis = groups_valid & ~survivor_nb
+    return uk, jnp.zeros(gcap, jnp.uint8), mis
+
+
+def _vert_emit_edges_dev(uk, nv, vo, vals, gc, vc):
+    """Per row: rebuild the canonical edge with the loser tag as marker."""
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    v = jnp.take(uk, jnp.maximum(seg, 0))
+    u = vals[:, 0]
+    okey = jnp.stack([jnp.minimum(v, u), jnp.maximum(v, u)], 1)
+    return okey, vals[:, 1], rows_valid
+
 
 def edge_winner(fr, kv, ptr):
     """KMV edge:[flags] → (v : [other, key-won]) per alive edge, both
     directions (reduce_edge_winner, oink/luby_find.cpp:140-182)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _edge_winner_dev, static=(int(ptr),)))
+        return
     fr = host_kmv(fr)
     if len(fr) == 0:
         return
@@ -86,6 +169,9 @@ def edge_winner(fr, kv, ptr):
 def vert_winner(fr, kv, ptr):
     """Group per v of [other, won]: v wins all edges ⇒ round-winner; emit
     (other : [v, v-is-round-winner]) (reduce_vert_winner)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _vert_winner_dev))
+        return
     fr = host_kmv(fr)
     if len(fr) == 0:
         return
@@ -99,6 +185,9 @@ def vert_winner(fr, kv, ptr):
 def vert_loser(fr, kv, ptr):
     """Group per v of [other, other-is-round-winner]: any winner neighbour
     ⇒ v is a loser; emit (other : [v, v-is-loser]) (reduce_vert_loser)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _vert_loser_dev))
+        return
     fr = host_kmv(fr)
     if len(fr) == 0:
         return
@@ -115,6 +204,10 @@ def vert_emit(fr, kv, ptr):
     round's edges with the loser tag as dead-marker
     (reduce_vert_emit, oink/luby_find.cpp:289-344)."""
     mrv = ptr
+    if is_sharded_kmv(fr):
+        mrv.kv.add_frame(skmv_map(fr, _vert_emit_mis_dev))
+        kv.add_frame(skmv_map(fr, _vert_emit_edges_dev))
+        return
     fr = host_kmv(fr)
     if len(fr) == 0:
         return
@@ -130,11 +223,19 @@ def vert_emit(fr, kv, ptr):
     kv.add_batch(edges, vals[:, 1])
 
 
+def _copy_edge_dev(k, v, c):
+    valid = (jnp.arange(k.shape[0]) < c) & (k[:, 0] != k[:, 1])
+    return k, jnp.zeros(k.shape[0], jnp.uint8), valid
+
+
 def copy_edge(fr, kv, ptr):
     """Eij:NULL → Eij:NULL working copy, self-loops dropped — a self-loop
     vertex can never win its own edge and would cycle forever (the
     reference's map_vert_random carries them into the same livelock;
     we guard like edge_upper does)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _copy_edge_dev))
+        return
     e = kv_keys(fr)
     e = e[e[:, 0] != e[:, 1]]
     kv.add_batch(e, np.zeros(len(e), np.uint8))
@@ -160,6 +261,8 @@ class LubyFind(Command):
     def run(self):
         obj = self.obj
         mre = obj.input(1, read_edge)
+        mre.aggregate()   # mesh: shard once; the round loop below then
+        #                   stays device-resident (serial: no-op)
         mrv = obj.create_mr()
         mrw = obj.create_mr()
 

@@ -54,12 +54,34 @@ NO_PRED = -1.0                   # see module docstring: sentinel, not id 0
 
 
 # ---------------------------------------------------------------------------
-# batch kernels
+# batch kernels — host bodies plus per-shard device bodies (shard_map), so
+# the mesh relaxation loop never materialises a frame on the controller.
 # ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from ...parallel.devkernels import (is_sharded_kmv, is_sharded_kv,
+                                    kmv_row_state, seg_lex_min2, seg_max_u64,
+                                    seg_min_with, skmv_map, skv_map)
+
+_INF = jnp.float64(jnp.inf)
+
+
+def _reorganize_edges_dev(k, v, c):
+    n = k.shape[0]
+    valid = jnp.arange(n) < c
+    oval = jnp.stack([jnp.zeros(n, jnp.float64),
+                      k[:, 1].astype(jnp.float64),
+                      v.astype(jnp.float64), jnp.zeros(n, jnp.float64)], 1)
+    return k[:, 0], oval, valid
+
 
 def reorganize_edges(fr, kv, ptr):
     """Eij:wt → vi:[0, vj, wt, 0] (reference reorganize_edges,
     oink/sssp.cpp:187-199 — directed out-edges keyed by source)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _reorganize_edges_dev))
+        return
     e = kv_keys(fr)
     wt = kv_values(fr).astype(np.float64)
     rows = np.stack([np.full(len(e), TAG_EDGE),
@@ -68,13 +90,54 @@ def reorganize_edges(fr, kv, ptr):
     kv.add_batch(e[:, 0], rows)
 
 
+def _init_distance_dev(k, v, c):
+    n = k.shape[0]
+    valid = jnp.arange(n) < c
+    row = jnp.asarray(np.array([TAG_DIST, NO_PRED, np.inf, 1.0]))
+    return k, jnp.tile(row, (n, 1)), valid
+
+
 def init_distance(fr, kv, ptr):
     """v:* → v:[1, NO_PRED, inf, 1] (initialize_vertex_distances,
     oink/sssp.cpp:231-237; DISTANCE() default wt=FLT_MAX, pred sentinel
     corrected per module docstring)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _init_distance_dev))
+        return
     k = kv_keys(fr)
     rows = np.tile(np.array([TAG_DIST, NO_PRED, np.inf, 1.0]), (len(k), 1))
     kv.add_batch(k, rows)
+
+
+def _pick_shortest_state(uk, nv, vo, vals, gc, vc):
+    """Per group: winner (min dist, pred) state row, every valid group."""
+    gcap = uk.shape[0]
+    seg, rows_valid, groups_valid = kmv_row_state(nv, vo, vals, gc, vc)
+    wdist, wpred = seg_lex_min2(vals[:, 2], vals[:, 1], seg, rows_valid,
+                                gcap, _INF, _INF)
+    out = jnp.stack([jnp.ones(gcap, jnp.float64), wpred, wdist,
+                     jnp.ones(gcap, jnp.float64)], 1)
+    return uk, out, groups_valid
+
+
+def _pick_shortest_changed(uk, nv, vo, vals, gc, vc):
+    """Per group: the winner row again, but only where it differs from the
+    group's previous current row (or no current row existed)."""
+    gcap = uk.shape[0]
+    seg, rows_valid, groups_valid = kmv_row_state(nv, vo, vals, gc, vc)
+    wdist, wpred = seg_lex_min2(vals[:, 2], vals[:, 1], seg, rows_valid,
+                                gcap, _INF, _INF)
+    is_cur = rows_valid & (vals[:, 3] == 1.0)
+    pdist = seg_min_with(vals[:, 2], seg, is_cur, gcap, _INF)
+    ppred = seg_min_with(vals[:, 1], seg, is_cur, gcap, _INF)
+    has_prev = seg_max_u64(jnp.ones(vals.shape[0], jnp.uint64), seg,
+                           is_cur, gcap) > 0
+    neq = lambda x, y: ~((x == y) | (jnp.isnan(x) & jnp.isnan(y)))
+    changed = groups_valid & (~has_prev | neq(wdist, pdist)
+                              | neq(wpred, ppred))
+    out = jnp.stack([jnp.ones(gcap, jnp.float64), wpred, wdist,
+                     jnp.ones(gcap, jnp.float64)], 1)
+    return uk, out, changed
 
 
 def pick_shortest(fr, kv, ptr):
@@ -83,6 +146,10 @@ def pick_shortest(fr, kv, ptr):
     candidate MR iff it differs from the previous current row
     (pick_shortest_distances, oink/sssp.cpp:244-293)."""
     mrpath = ptr
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _pick_shortest_state))
+        mrpath.kv.add_frame(skmv_map(fr, _pick_shortest_changed))
+        return
     fr = host_kmv(fr)
     if len(fr) == 0:
         return
@@ -112,12 +179,47 @@ def pick_shortest(fr, kv, ptr):
         mrpath.kv.add_batch(keys[changed], out[changed])
 
 
+def _update_adjacent_edges(uk, nv, vo, vals, gc, vc):
+    """Per row: re-emit the adjacency rows unchanged."""
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    is_edge = vals[:, 0] == TAG_EDGE
+    okey = jnp.take(uk, jnp.maximum(seg, 0))
+    return okey, vals, rows_valid & is_edge
+
+
+def _update_adjacent_relax(uk, nv, vo, vals, gc, vc):
+    """Per edge row: relax with the group's best arriving distance."""
+    gcap = uk.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    is_dist = rows_valid & (vals[:, 0] == TAG_DIST)
+    bdist, bpred = seg_lex_min2(vals[:, 2], vals[:, 1], seg, is_dist,
+                                gcap, _INF, _INF)
+    has_dist = seg_max_u64(jnp.ones(vals.shape[0], jnp.uint64), seg,
+                           is_dist, gcap) > 0
+    g = jnp.maximum(seg, 0)
+    is_edge = rows_valid & (vals[:, 0] == TAG_EDGE)
+    vj = vals[:, 1]
+    vi = jnp.take(uk, g).astype(jnp.float64)
+    relax = (is_edge & jnp.take(has_dist, g) & (vj != jnp.take(bpred, g))
+             & (vj != vi) & jnp.isfinite(jnp.take(bdist, g)))
+    okey = vj.astype(jnp.uint64)
+    n = vals.shape[0]
+    oval = jnp.stack([jnp.ones(n, jnp.float64), vi,
+                      jnp.take(bdist, g) + vals[:, 2],
+                      jnp.zeros(n, jnp.float64)], 1)
+    return okey, oval, relax
+
+
 def update_adjacent(fr, kv, ptr):
     """Per-vertex group of edge rows + changed-distance rows: re-emit the
     adjacency; if a distance arrived, relax every out-edge into the open
     candidate MR — skipping the predecessor and self-loops
     (update_adjacent_distances, oink/sssp.cpp:299-360)."""
     mrpath = ptr
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _update_adjacent_edges))
+        mrpath.kv.add_frame(skmv_map(fr, _update_adjacent_relax))
+        return
     fr = host_kmv(fr)
     if len(fr) == 0:
         return
@@ -179,6 +281,8 @@ class SSSPCommand(Command):
     def run(self):
         obj = self.obj
         mredge = obj.input(1, read_edge_weight)
+        mredge.aggregate()   # mesh: shard once; the relaxation loop stays
+        #                      device-resident (serial: no-op)
 
         # vertex universe (no singletons, pre-aggregated — sssp.cpp:63-66)
         mrvert = obj.create_mr()

@@ -23,10 +23,36 @@ from ..kernels import (_parse_cols, edge_both_directions, host_kmv, kmv_keys,
                        sum_values)
 
 
+import jax.numpy as jnp
+
+from ...parallel.devkernels import (is_sharded_kmv, is_sharded_kv,
+                                    kmv_row_state, seg_max_u64, skmv_map,
+                                    skv_map)
+from ...parallel.sharded import round_cap
+
+
+def _first_degree_dev(uk, nv, vo, vals, gc, vc):
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    g = jnp.maximum(seg, 0)
+    nb = vals.astype(jnp.uint64)
+    center = jnp.take(uk, g).astype(jnp.uint64)
+    d = jnp.take(nv, g).astype(jnp.uint64)
+    lo = jnp.minimum(center, nb)
+    hi = jnp.maximum(center, nb)
+    is_i = center < nb
+    zero = jnp.zeros_like(d)
+    oval = jnp.stack([jnp.where(is_i, d, zero),
+                      jnp.where(is_i, zero, d)], 1)
+    return jnp.stack([lo, hi], 1), oval, rows_valid
+
+
 def first_degree(fr, kv, ptr):
     """Per-vertex group (neighbors list, size d): emit canonical edge →
     (d,0) or (0,d) depending on which endpoint the center is
     (reduce_first_degree, oink/tri_find.cpp:116-159)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _first_degree_dev))
+        return
     fr = host_kmv(fr)
     nb = kmv_values(fr).astype(np.uint64)            # [n] neighbor ids
     center = np.repeat(kmv_keys(fr).astype(np.uint64), fr.nvalues)
@@ -40,9 +66,20 @@ def first_degree(fr, kv, ptr):
     kv.add_batch(np.stack([lo, hi], 1), np.stack([di, dj], 1))
 
 
+def _low_degree_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    low_is_i = (v[:, 0] < v[:, 1]) | ((v[:, 0] == v[:, 1]) &
+                                      (k[:, 0] < k[:, 1]))
+    return (jnp.where(low_is_i, k[:, 0], k[:, 1]),
+            jnp.where(low_is_i, k[:, 1], k[:, 0]), valid)
+
+
 def low_degree(fr, kv, ptr):
     """(Eij:(Di,Dj)) → lower-degree endpoint : other endpoint; degree tie
     broken toward Vi (map_low_degree, oink/tri_find.cpp:185-207)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _low_degree_dev))
+        return
     e = kv_keys(fr)
     deg = kv_values(fr)
     low_is_i = (deg[:, 0] < deg[:, 1]) | ((deg[:, 0] == deg[:, 1]) &
@@ -51,10 +88,46 @@ def low_degree(fr, kv, ptr):
                  np.where(low_is_i, e[:, 1], e[:, 0]))
 
 
+def _nsq_angles_dev(uk, nv, vo, vals, gc, vc, out_cap):
+    vcap = vals.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    g = jnp.maximum(seg, 0)
+    end = jnp.take(vo + nv, g)                       # group end row
+    rem = jnp.where(rows_valid,
+                    end - jnp.arange(vcap, dtype=jnp.int32) - 1, 0)
+    rem = jnp.maximum(rem, 0)
+    j_idx = jnp.repeat(jnp.arange(vcap), rem, total_repeat_length=out_cap)
+    off = jnp.concatenate([jnp.zeros(1, rem.dtype), jnp.cumsum(rem)])
+    total = off[-1]
+    pos = jnp.arange(out_cap)
+    valid_out = pos < total
+    k_idx = jnp.clip(pos - jnp.take(off, j_idx) + j_idx + 1, 0, vcap - 1)
+    nb = vals.astype(jnp.uint64)
+    vj = jnp.take(nb, j_idx)
+    vk = jnp.take(nb, k_idx)
+    center = jnp.take(uk, jnp.take(g, j_idx)).astype(jnp.uint64)
+    lo = jnp.minimum(vj, vk)
+    hi = jnp.maximum(vj, vk)
+    one = jnp.ones(out_cap, jnp.uint64)
+    oval = jnp.stack([one, center, one - 1], 1)
+    return jnp.stack([lo, hi], 1), oval, valid_out
+
+
 def nsq_angles(fr, kv, ptr):
     """Per-center group: every unordered neighbor pair (Vj,Vk) is an "angle"
     (a triangle missing the Vj-Vk edge): emit canonical (Vj,Vk) → [1,center,0]
     (reduce_nsq_angles, oink/tri_find.cpp:211-276, the O(d²) kernel)."""
+    if is_sharded_kmv(fr):
+        # static expansion cap: worst shard's Σ d(d-1)/2, from the group
+        # sizes (one host fetch of the int32 size column, not the data)
+        P, gcap = fr.nprocs, fr.gcap
+        nv = np.asarray(fr.nvalues).reshape(P, gcap).astype(np.int64)
+        m = np.arange(gcap)[None, :] < fr.gcounts[:, None]
+        nv = np.where(m, nv, 0)
+        per_shard = (nv * (nv - 1) // 2).sum(axis=1)
+        out_cap = round_cap(int(max(1, per_shard.max())))
+        kv.add_frame(skmv_map(fr, _nsq_angles_dev, static=(out_cap,)))
+        return
     fr = host_kmv(fr)
     nb = kmv_values(fr).astype(np.uint64)
     n = len(nb)
@@ -73,17 +146,41 @@ def nsq_angles(fr, kv, ptr):
                  np.stack([one, center, np.zeros(len(lo), np.uint64)], 1))
 
 
+def _edge_null_tagged_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    return k, jnp.zeros((k.shape[0], 3), jnp.uint64), valid
+
+
 def edge_null_tagged(fr, kv, ptr):
     """Eij:NULL → Eij:[0,0,0] — original-edge marker rows for the angle
     join (the reference reuses valuebytes==0)."""
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _edge_null_tagged_dev))
+        return
     e = kv_keys(fr)
     kv.add_batch(e, np.zeros((len(e), 3), np.uint64))
+
+
+def _emit_triangles_dev(uk, nv, vo, vals, gc, vc):
+    gcap = uk.shape[0]
+    seg, rows_valid, _ = kmv_row_state(nv, vo, vals, gc, vc)
+    g = jnp.maximum(seg, 0)
+    is_edge = rows_valid & (vals[:, 0] == 0)
+    has_edge = seg_max_u64(jnp.ones(vals.shape[0], jnp.uint64), seg,
+                           is_edge, gcap) > 0
+    take = rows_valid & (vals[:, 0] != 0) & jnp.take(has_edge, g)
+    e = jnp.take(uk, g, axis=0).astype(jnp.uint64)     # [vcap, 2]
+    okey = jnp.stack([vals[:, 1], e[:, 0], e[:, 1]], 1)
+    return okey, jnp.zeros(vals.shape[0], jnp.uint8), take
 
 
 def emit_triangles(fr, kv, ptr):
     """Per-edge group of tagged rows: if an original-edge marker is present,
     every angle row (center Vi) completes a triangle (Vi,Vj,Vk)
     (reduce_emit_triangles, oink/tri_find.cpp:280-...)."""
+    if is_sharded_kmv(fr):
+        kv.add_frame(skmv_map(fr, _emit_triangles_dev))
+        return
     fr = host_kmv(fr)
     vals = kmv_values(fr)                            # [n,3] tagged
     seg = seg_ids(fr)
@@ -117,6 +214,8 @@ class TriFind(Command):
     def run(self):
         obj = self.obj
         mre = obj.input(1, read_edge)
+        mre.aggregate()   # mesh: shard once; all stages below stay
+        #                   device-resident (serial: no-op)
         mrt = obj.create_mr()
 
         # augment edges with endpoint degrees: mrt = (Eij, (Di, Dj))

@@ -154,8 +154,17 @@ def read_words(itask, filename, kv, ptr):
 # edge/vertex maps (batch: fn(frame, kv, ptr))
 # ---------------------------------------------------------------------------
 
+def _dev(name):
+    from ..parallel import devkernels
+    return getattr(devkernels, name)
+
+
 def edge_to_vertices(fr, kv, ptr):
     """Eij:NULL → Vi:NULL and Vj:NULL (map_edge_to_vertices.cpp)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("edge_to_vertices_dev")))
+        return
     e = kv_keys(fr)
     both = np.concatenate([e[:, 0], e[:, 1]])
     kv.add_batch(both, _null(len(both)))
@@ -163,12 +172,20 @@ def edge_to_vertices(fr, kv, ptr):
 
 def edge_to_vertex(fr, kv, ptr):
     """Eij:NULL → Vi:NULL only (map_edge_to_vertex.cpp)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("edge_to_vertex_dev")))
+        return
     e = kv_keys(fr)
     kv.add_batch(e[:, 0], _null(len(e)))
 
 
 def edge_to_vertex_pair(fr, kv, ptr):
     """Eij:NULL → Vi:Vj (map_edge_to_vertex_pair.cpp)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("edge_to_vertex_pair_dev")))
+        return
     e = kv_keys(fr)
     kv.add_batch(e[:, 0], e[:, 1])
 
@@ -177,6 +194,10 @@ def edge_both_directions(fr, kv, ptr):
     """Eij:NULL → Vi:Vj and Vj:Vi — the adjacency expansion shared by
     neighbor (oink/neighbor.cpp:84-116) and tri_find's map_edge_vert
     (oink/tri_find.cpp:104-112)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("edge_both_directions_dev")))
+        return
     e = kv_keys(fr)
     kv.add_batch(np.concatenate([e[:, 0], e[:, 1]]),
                  np.concatenate([e[:, 1], e[:, 0]]))
@@ -184,6 +205,10 @@ def edge_both_directions(fr, kv, ptr):
 
 def edge_upper(fr, kv, ptr):
     """Canonicalise to Vi<Vj, drop self-loops (map_edge_upper.cpp:15-24)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("edge_upper_dev")))
+        return
     e = kv_keys(fr)
     keep = e[:, 0] != e[:, 1]
     e = e[keep]
@@ -194,12 +219,20 @@ def edge_upper(fr, kv, ptr):
 
 def invert(fr, kv, ptr):
     """K:V → V:K (map_invert.cpp)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("invert_dev")))
+        return
     fr = host_kv(fr)
     kv.add_batch(fr.value, fr.key)
 
 
 def add_weight(fr, kv, ptr):
     """Eij:NULL → Eij:1.0 (map_add_weight.cpp — unit edge weights)."""
+    from ..parallel.devkernels import is_sharded_kv, skv_map
+    if is_sharded_kv(fr):
+        kv.add_frame(skv_map(fr, _dev("add_weight_dev")))
+        return
     fr = host_kv(fr)
     kv.add_batch(fr.key, np.ones(len(fr), np.float64))
 

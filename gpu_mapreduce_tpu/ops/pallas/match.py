@@ -245,15 +245,17 @@ def mark_words_pallas(words, pattern: bytes, interpret: bool = False):
     if words.dtype != jnp.int32:
         words = jax.lax.bitcast_convert_type(words, jnp.int32)
     blk = WORD_BLOCK_ROWS * LANES
-    words = _pad_to(words, blk)
-    rows = words.shape[0] // LANES
-    grid = rows // WORD_BLOCK_ROWS
-    words_2d = jnp.concatenate(
-        [words.reshape(rows, LANES),
-         jnp.zeros((WORD_BLOCK_ROWS, LANES), jnp.int32)])
+    # one concatenate: round up to a block multiple AND append the zero
+    # sentinel block the next-block-head index map reads past the end
+    pad = (-m) % blk + blk
+    words = jnp.concatenate([words, jnp.zeros(pad, jnp.int32)])
+    rows = words.shape[0] // LANES               # incl. the sentinel block
+    grid = rows // WORD_BLOCK_ROWS - 1
+    out_rows = grid * WORD_BLOCK_ROWS            # mask excludes the sentinel
+    words_2d = words.reshape(rows, LANES)
     out = pl.pallas_call(
         functools.partial(_mark_words_kernel, masks, vals),
-        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
+        out_shape=jax.ShapeDtypeStruct((out_rows, LANES), jnp.int8),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec((WORD_BLOCK_ROWS, LANES), lambda i: (i, _i32(0)),

@@ -1,0 +1,240 @@
+"""Device twins of the OINK graph kernels — shard-resident iteration.
+
+Round 1 ran every graph-command callback by pulling ShardedKV/ShardedKMV to
+host numpy each round (``oink/kernels.py`` ``host_kv``/``host_kmv``) — the
+mesh shuffled on device but computed on the controller, which caps scaling
+at the controller's memory and PCIe (VERDICT r1 #4).  This module gives the
+iterative commands (cc_find, luby_find, sssp, tri_find, degree …) a
+*device tier*: each batch kernel has a per-shard jittable body running
+under ``shard_map``, so a whole iteration is shuffle → segment ops →
+emit, all in HBM; the only host traffic is the per-op row counts — the
+same scalars the reference Allreduces after every op
+(``src/mapreduce.cpp:557-558``).
+
+Kernel bodies follow one convention: they receive the shard's padded
+blocks and return ``(key_rows, value_rows, valid_mask)`` of one static
+shape; the wrapper packs valid rows to the front (stable, so emission
+order within a shard is deterministic), counts them, and wraps a new
+:class:`ShardedKV`.  Row counts per shard are data-dependent — the pack +
+count IS the TPU version of the reference's "emit into the open KV page".
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .group import _local_segment_ids
+from .mesh import AXIS, row_sharding
+from .sharded import ShardedKMV, ShardedKV
+
+U64MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def is_sharded_kv(fr) -> bool:
+    return isinstance(fr, ShardedKV)
+
+
+def is_sharded_kmv(fr) -> bool:
+    return isinstance(fr, ShardedKMV)
+
+
+def _pack(ok, ov, valid):
+    order = jnp.argsort(~valid, stable=True)
+    return (jnp.take(ok, order, axis=0), jnp.take(ov, order, axis=0),
+            jnp.sum(valid.astype(jnp.int32))[None])
+
+
+@functools.lru_cache(maxsize=None)
+def _skv_map_jit(mesh, fn, static):
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(key, value, count):
+        def body(k, v, c):
+            return _pack(*fn(k, v, c[0], *static))
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=(spec, spec, spec))(key, value, count)
+
+    return run
+
+
+def skv_map(skv: ShardedKV, fn, static=()) -> ShardedKV:
+    """Run a per-shard KV kernel body ``fn(key, value, count, *static) →
+    (okey, ovalue, valid)`` and pack the result into a new ShardedKV.
+    ``static`` must be hashable (jit-constant parameters, e.g. a seed)."""
+    counts = jax.device_put(skv.counts.astype(np.int32),
+                            row_sharding(skv.mesh))
+    k, v, c = _skv_map_jit(skv.mesh, fn, tuple(static))(
+        skv.key, skv.value, counts)
+    return ShardedKV(skv.mesh, k, v, np.asarray(c).astype(np.int32))
+
+
+@functools.lru_cache(maxsize=None)
+def _skmv_map_jit(mesh, fn, static):
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(ukey, nval, voff, values, gcount, vcount):
+        def body(uk, nv, vo, vals, gc, vc):
+            return _pack(*fn(uk, nv, vo, vals, gc[0], vc[0], *static))
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,) * 6,
+            out_specs=(spec, spec, spec))(ukey, nval, voff, values,
+                                          gcount, vcount)
+
+    return run
+
+
+def skmv_map(kmv: ShardedKMV, fn, static=()) -> ShardedKV:
+    """Run a per-shard KMV kernel body ``fn(ukey, nvalues, voffsets,
+    values, gcount, vcount, *static) → (okey, ovalue, valid)`` (a
+    vectorised appreduce) and pack into a new ShardedKV."""
+    put = lambda x: jax.device_put(x.astype(np.int32), row_sharding(kmv.mesh))
+    k, v, c = _skmv_map_jit(kmv.mesh, fn, tuple(static))(
+        kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values,
+        put(kmv.gcounts), put(kmv.vcounts))
+    return ShardedKV(kmv.mesh, k, v, np.asarray(c).astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# shard-resident concat (MapReduce.add of two mesh datasets)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _concat_jit(mesh):
+    spec = P(AXIS)
+
+    @jax.jit
+    def run(k1, v1, c1, k2, v2, c2):
+        def body(ka, va, ca, kb, vb, cb):
+            na, nb = ka.shape[0], kb.shape[0]
+            valid = jnp.concatenate([jnp.arange(na) < ca[0],
+                                     jnp.arange(nb) < cb[0]])
+            return _pack(jnp.concatenate([ka, kb]),
+                         jnp.concatenate([va, vb]), valid)
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 6,
+                             out_specs=(spec, spec, spec))(k1, v1, c1,
+                                                           k2, v2, c2)
+
+    return run
+
+
+def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
+    """Per-shard concatenation of two mesh KV datasets (the device path of
+    ``MapReduce::add``, src/mapreduce.cpp:348-374)."""
+    assert a.mesh is b.mesh or a.mesh == b.mesh
+    put = lambda s: jax.device_put(s.counts.astype(np.int32),
+                                   row_sharding(a.mesh))
+    k, v, c = _concat_jit(a.mesh)(a.key, a.value, put(a), b.key, b.value,
+                                  put(b))
+    return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32))
+
+
+def clone_sharded(skv: ShardedKV) -> ShardedKMV:
+    """KV→KMV with every row its own single-value group, per shard
+    (the device path of ``MapReduce::clone``, src/mapreduce.cpp:631-652)."""
+    P, cap = skv.nprocs, skv.cap
+    nv = (np.arange(cap)[None, :] < skv.counts[:, None]).astype(np.int32)
+    vo = np.tile(np.arange(cap, dtype=np.int32), (P, 1))
+    sharding = row_sharding(skv.mesh)
+    return ShardedKMV(skv.mesh, skv.key,
+                      jax.device_put(nv.reshape(-1), sharding),
+                      jax.device_put(vo.reshape(-1), sharding),
+                      skv.value, skv.counts.copy(), skv.counts.copy())
+
+
+# ---------------------------------------------------------------------------
+# segment helpers shared by the KMV kernel bodies
+# ---------------------------------------------------------------------------
+
+def kmv_row_state(nv, vo, vals, gc, vc):
+    """Common prologue: (segment ids [vcap], row-valid [vcap],
+    group-valid [gcap])."""
+    vcap = vals.shape[0]
+    seg = _local_segment_ids(vo, nv, vcap)
+    rows_valid = (jnp.arange(vcap) < vc) & (seg >= 0)
+    groups_valid = jnp.arange(nv.shape[0]) < gc
+    return seg, rows_valid, groups_valid
+
+
+def seg_min_u64(x, seg, valid, gcap):
+    v = jnp.where(valid, x, U64MAX)
+    return jax.ops.segment_min(v, jnp.where(valid, seg, gcap),
+                               num_segments=gcap + 1)[:gcap]
+
+
+def seg_max_u64(x, seg, valid, gcap):
+    v = jnp.where(valid, x, jnp.uint64(0))
+    return jax.ops.segment_max(v, jnp.where(valid, seg, gcap),
+                               num_segments=gcap + 1)[:gcap]
+
+
+def seg_min_with(x, seg, valid, gcap, identity):
+    """Segment min with an explicit identity (f64 paths use +inf)."""
+    v = jnp.where(valid, x, identity)
+    return jax.ops.segment_min(v, jnp.where(valid, seg, gcap),
+                               num_segments=gcap + 1)[:gcap]
+
+
+def seg_lex_min2(a, b, seg, valid, gcap, ident_a, ident_b):
+    """Per-segment lexicographic min of (a, b) rows: returns (amin, bmin)
+    where amin = min a and bmin = min b among rows attaining amin —
+    the shared 'best (dist, pred) per vertex' idiom (sssp)."""
+    amin = seg_min_with(a, seg, valid, gcap, ident_a)
+    att = valid & (a == jnp.take(amin, jnp.maximum(seg, 0)))
+    bmin = seg_min_with(b, seg, att, gcap, ident_b)
+    return amin, bmin
+
+
+# ---------------------------------------------------------------------------
+# generic edge/vertex kernel bodies (device twins of oink/kernels.py maps)
+# ---------------------------------------------------------------------------
+
+def _null_like(k):
+    return jnp.zeros(k.shape[0], jnp.uint8)
+
+
+def edge_to_vertices_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    okey = jnp.concatenate([k[:, 0], k[:, 1]])
+    vv = jnp.concatenate([valid, valid])
+    return okey, _null_like(okey), vv
+
+
+def edge_to_vertex_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    return k[:, 0], _null_like(k), valid
+
+
+def edge_to_vertex_pair_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    return k[:, 0], k[:, 1], valid
+
+
+def edge_both_directions_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    okey = jnp.concatenate([k[:, 0], k[:, 1]])
+    oval = jnp.concatenate([k[:, 1], k[:, 0]])
+    return okey, oval, jnp.concatenate([valid, valid])
+
+
+def edge_upper_dev(k, v, c):
+    valid = (jnp.arange(k.shape[0]) < c) & (k[:, 0] != k[:, 1])
+    lo = jnp.minimum(k[:, 0], k[:, 1])
+    hi = jnp.maximum(k[:, 0], k[:, 1])
+    return jnp.stack([lo, hi], 1), _null_like(k), valid
+
+
+def invert_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    return v, k, valid
+
+
+def add_weight_dev(k, v, c):
+    valid = jnp.arange(k.shape[0]) < c
+    return k, jnp.ones(k.shape[0], jnp.float64), valid

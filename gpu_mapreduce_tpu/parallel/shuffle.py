@@ -306,9 +306,13 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     if mesh_axis_size(backend.mesh) == 1:
         # reference early-out for nprocs==1 (src/mapreduce.cpp:403-406):
         # no exchange — but a dense host frame still moves onto the device
-        # so convert/reduce run the sharded (device) tier
-        if isinstance(frame, KVFrame) and frame.is_dense():
-            _replace_kv_frames(kv, shard_frame(frame, backend.mesh))
+        # so convert/reduce run the sharded (device) tier, and an already-
+        # computed multi-frame concat is kept (one_frame above was not free)
+        if isinstance(frame, KVFrame):
+            if frame.is_dense():
+                _replace_kv_frames(kv, shard_frame(frame, backend.mesh))
+        else:
+            _replace_kv_frames(kv, frame)
         return
     if isinstance(frame, KVFrame):
         if not frame.is_dense():

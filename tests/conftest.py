@@ -8,7 +8,11 @@ without TPU hardware.  Must run before jax initialises its backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE (not setdefault): the outer environment may pin JAX_PLATFORMS to the
+# TPU plugin ("axon"); subprocesses spawned by tests (the C-binding
+# binaries embed Python) inherit os.environ and must get CPU like the test
+# process itself does via jax.config below.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

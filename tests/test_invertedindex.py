@@ -176,8 +176,5 @@ def test_pipeline_on_single_device_mesh(html_corpus):
     assert isinstance(fr, ShardedKV)
     import numpy as np
     counts = {int(k): int(v) for k, v in fr.to_host().pairs()}
-    ref = {}
-    for k, vals in (
-            lambda m: m)(ii1.mr.kv.one_frame()).pairs():
-        ref[int(k)] = int(vals)
+    ref = {int(k): int(v) for k, v in ii1.mr.kv.one_frame().pairs()}
     assert counts == ref
