@@ -199,6 +199,11 @@ class KeyValue:
     def nframes(self) -> int:
         return len(self._frames)
 
+    def is_host_dataset(self) -> bool:
+        """True when every frame is a host KVFrame or a spill file (the
+        external sort/group machinery operates on these)."""
+        return all(isinstance(f, (KVFrame, _Spilled)) for f in self._frames)
+
     def frames(self) -> Iterator[KVFrame]:
         """Stream frames (reference request_info/request_page cursor,
         src/keyvalue.cpp:277-308)."""

@@ -276,21 +276,37 @@ class MapReduce:
     # ------------------------------------------------------------------
     # grouping ops
     # ------------------------------------------------------------------
+    def _use_external(self, kv: KeyValue) -> bool:
+        """Out-of-core multi-frame host dataset ⇒ stream through the
+        external sort/merge instead of consolidating in core."""
+        return (self.settings.outofcore == 1 and kv.nframes > 1
+                and kv.is_host_dataset())
+
     def convert(self) -> int:
         """Local KV→KMV grouping (reference src/mapreduce.cpp:861-886 →
-        KeyMultiValue::convert; here sort+segment, SURVEY.md §3.3)."""
+        KeyMultiValue::convert; here sort+segment, SURVEY.md §3.3).  An
+        out-of-core multi-frame dataset streams: external sort runs →
+        k-way merge → group-boundary frame cuts, in ~one page budget of
+        memory (the Spool cascade's job, src/mapreduce.cpp:2359-2633)."""
         t = Timer()
         kv = self._require_kv("convert")
-        frame = kv.one_frame()
-        if isinstance(frame, KVFrame):
-            kmv_frame = group_frame(frame)
-        else:  # ShardedKV → per-shard sort+segment under shard_map
-            from ..parallel.group import convert_sharded
-            kmv_frame = convert_sharded(frame, self.counters)
+        self.kmv = self._new_kmv()
+        if self._use_external(kv):
+            from .external import external_sorted_chunks, group_stream
+            chunks = external_sorted_chunks(kv.frames(), "key",
+                                            self.settings, self.counters)
+            for kmv_frame in group_stream(chunks):
+                self.kmv.push(kmv_frame)
+        else:
+            frame = kv.one_frame()
+            if isinstance(frame, KVFrame):
+                kmv_frame = group_frame(frame)
+            else:  # ShardedKV → per-shard sort+segment under shard_map
+                from ..parallel.group import convert_sharded
+                kmv_frame = convert_sharded(frame, self.counters)
+            self.kmv.push(kmv_frame)
         kv.free()
         self.kv = None
-        self.kmv = self._new_kmv()
-        self.kmv.push(kmv_frame)
         n = self.kmv.complete()
         self._op_stats("convert", nkmv=n)
         self._time("convert", t)
@@ -471,6 +487,8 @@ class MapReduce:
     def _sort_kv(self, by: str, flag_or_cmp) -> int:
         t = Timer()
         kv = self._require_kv(f"sort_{by}s")
+        if not callable(flag_or_cmp) and self._use_external(kv):
+            return self._sort_kv_external(kv, by, flag_or_cmp < 0, t)
         fr = kv.one_frame()
         if not isinstance(fr, KVFrame):
             if not callable(flag_or_cmp):  # per-shard device sort
@@ -495,6 +513,29 @@ class MapReduce:
         self._op_stats(f"sort_{by}s", nkv=n)
         self._time("sort", t)
         return int(self.backend.allreduce_sum(n))
+
+    def _sort_kv_external(self, kv: KeyValue, by: str, descending: bool,
+                          t: Timer) -> int:
+        """Out-of-core sort: external runs + k-way merge into a fresh
+        spilling dataset; descending flips each ascending chunk and
+        reverses the frame order (global order preserved, memory
+        bounded)."""
+        from .external import external_sorted_chunks
+        newkv = self._new_kv()
+        for ch in external_sorted_chunks(kv.frames(), by, self.settings,
+                                         self.counters):
+            if descending:
+                ch = ch.take(np.arange(len(ch) - 1, -1, -1))
+            newkv._push_frame(ch)
+        if descending:
+            newkv._frames.reverse()
+        newkv.nkv = sum(newkv._frame_n(f) for f in newkv._frames)
+        newkv.complete_done = True
+        kv.free()
+        self.kv = newkv
+        self._op_stats(f"sort_{by}s", nkv=newkv.nkv)
+        self._time("sort", t)
+        return int(self.backend.allreduce_sum(newkv.nkv))
 
     def sort_multivalues(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Sort values *within* each multivalue (reference

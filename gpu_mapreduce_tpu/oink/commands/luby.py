@@ -69,9 +69,10 @@ from ...parallel.devkernels import (is_sharded_kmv, is_sharded_kv,
                                     skv_map)
 
 
-def _vertex_rand_dev(v, seed: int):
-    """jnp twin of vertex_rand — identical splitmix64 bits."""
-    x = v.astype(jnp.uint64) + jnp.uint64(seed & 0xFFFFFFFFFFFFFFFF)
+def _vertex_rand_dev(v, seed):
+    """jnp twin of vertex_rand — identical splitmix64 bits.  ``seed`` is a
+    traced u64 scalar so a seed sweep re-uses one compiled kernel."""
+    x = v.astype(jnp.uint64) + seed.astype(jnp.uint64)
     x = x + jnp.uint64(0x9E3779B97F4A7C15)
     z = x
     z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
@@ -85,6 +86,7 @@ def _seg_any(cond, seg, valid, gcap):
 
 
 def _edge_winner_dev(uk, nv, vo, vals, gc, vc, seed):
+    # seed arrives as a traced u64 scalar (skmv_map `extra`)
     gcap = uk.shape[0]
     seg, rows_valid, groups_valid = kmv_row_state(nv, vo, vals, gc, vc)
     flag = vals if vals.ndim == 1 else vals[:, 0]
@@ -144,7 +146,8 @@ def edge_winner(fr, kv, ptr):
     """KMV edge:[flags] → (v : [other, key-won]) per alive edge, both
     directions (reduce_edge_winner, oink/luby_find.cpp:140-182)."""
     if is_sharded_kmv(fr):
-        kv.add_frame(skmv_map(fr, _edge_winner_dev, static=(int(ptr),)))
+        seed = jnp.uint64(int(ptr) & 0xFFFFFFFFFFFFFFFF)
+        kv.add_frame(skmv_map(fr, _edge_winner_dev, extra=(seed,)))
         return
     fr = host_kmv(fr)
     if len(fr) == 0:

@@ -44,60 +44,70 @@ def is_sharded_kmv(fr) -> bool:
 
 
 def _pack(ok, ov, valid):
-    order = jnp.argsort(~valid, stable=True)
-    return (jnp.take(ok, order, axis=0), jnp.take(ov, order, axis=0),
-            jnp.sum(valid.astype(jnp.int32))[None])
+    """Stable front-packing via prefix-sum + scatter-with-drop — the same
+    idiom compact_word_matches documents (~20× cheaper than the sort-based
+    form on TPU; positions are unique by construction)."""
+    n = valid.shape[0]
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    tgt = jnp.where(valid, pos, n)
+    okey = jnp.zeros_like(ok).at[tgt].set(ok, mode="drop")
+    oval = jnp.zeros_like(ov).at[tgt].set(ov, mode="drop")
+    return okey, oval, jnp.sum(valid.astype(jnp.int32))[None]
 
 
 @functools.lru_cache(maxsize=None)
-def _skv_map_jit(mesh, fn, static):
+def _skv_map_jit(mesh, fn, static, nextra):
     spec = P(AXIS)
 
     @jax.jit
-    def run(key, value, count):
-        def body(k, v, c):
-            return _pack(*fn(k, v, c[0], *static))
-        return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=(spec, spec, spec))(key, value, count)
+    def run(key, value, count, *extra):
+        def body(k, v, c, *ex):
+            return _pack(*fn(k, v, c[0], *ex, *static))
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec) + (P(),) * nextra,
+            out_specs=(spec, spec, spec))(key, value, count, *extra)
 
     return run
 
 
-def skv_map(skv: ShardedKV, fn, static=()) -> ShardedKV:
-    """Run a per-shard KV kernel body ``fn(key, value, count, *static) →
-    (okey, ovalue, valid)`` and pack the result into a new ShardedKV.
-    ``static`` must be hashable (jit-constant parameters, e.g. a seed)."""
+def skv_map(skv: ShardedKV, fn, static=(), extra=()) -> ShardedKV:
+    """Run a per-shard KV kernel body ``fn(key, value, count, *extra,
+    *static) → (okey, ovalue, valid)`` and pack the result into a new
+    ShardedKV.  ``static`` values are jit constants (shapes, caps);
+    ``extra`` values are TRACED replicated operands (seeds, thresholds) —
+    varying them re-uses the compiled kernel."""
     counts = jax.device_put(skv.counts.astype(np.int32),
                             row_sharding(skv.mesh))
-    k, v, c = _skv_map_jit(skv.mesh, fn, tuple(static))(
-        skv.key, skv.value, counts)
+    k, v, c = _skv_map_jit(skv.mesh, fn, tuple(static), len(extra))(
+        skv.key, skv.value, counts, *extra)
     return ShardedKV(skv.mesh, k, v, np.asarray(c).astype(np.int32))
 
 
 @functools.lru_cache(maxsize=None)
-def _skmv_map_jit(mesh, fn, static):
+def _skmv_map_jit(mesh, fn, static, nextra):
     spec = P(AXIS)
 
     @jax.jit
-    def run(ukey, nval, voff, values, gcount, vcount):
-        def body(uk, nv, vo, vals, gc, vc):
-            return _pack(*fn(uk, nv, vo, vals, gc[0], vc[0], *static))
+    def run(ukey, nval, voff, values, gcount, vcount, *extra):
+        def body(uk, nv, vo, vals, gc, vc, *ex):
+            return _pack(*fn(uk, nv, vo, vals, gc[0], vc[0], *ex, *static))
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec,) * 6,
+            body, mesh=mesh, in_specs=(spec,) * 6 + (P(),) * nextra,
             out_specs=(spec, spec, spec))(ukey, nval, voff, values,
-                                          gcount, vcount)
+                                          gcount, vcount, *extra)
 
     return run
 
 
-def skmv_map(kmv: ShardedKMV, fn, static=()) -> ShardedKV:
+def skmv_map(kmv: ShardedKMV, fn, static=(), extra=()) -> ShardedKV:
     """Run a per-shard KMV kernel body ``fn(ukey, nvalues, voffsets,
-    values, gcount, vcount, *static) → (okey, ovalue, valid)`` (a
-    vectorised appreduce) and pack into a new ShardedKV."""
+    values, gcount, vcount, *extra, *static) → (okey, ovalue, valid)`` (a
+    vectorised appreduce) and pack into a new ShardedKV.  ``extra`` as in
+    :func:`skv_map`."""
     put = lambda x: jax.device_put(x.astype(np.int32), row_sharding(kmv.mesh))
-    k, v, c = _skmv_map_jit(kmv.mesh, fn, tuple(static))(
+    k, v, c = _skmv_map_jit(kmv.mesh, fn, tuple(static), len(extra))(
         kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values,
-        put(kmv.gcounts), put(kmv.vcounts))
+        put(kmv.gcounts), put(kmv.vcounts), *extra)
     return ShardedKV(kmv.mesh, k, v, np.asarray(c).astype(np.int32))
 
 

@@ -1,0 +1,140 @@
+"""Out-of-core external sort/convert: bounded peak memory (VERDICT r1 #4).
+
+The reference's defining capability is running every op in a few fixed
+pages regardless of data size (doc/Interface_c++.txt:39-59; the Spool
+merge cascade).  These tests push a dataset ~10× the page budget through
+sort_keys / sort_values / convert+reduce and assert BOTH correctness vs
+in-core oracles AND that the `msizemax` hi-water stays ~2× the budget —
+the ONEMAX-style property round 1 never asserted."""
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu import MapReduce
+from gpu_mapreduce_tpu.core.runtime import global_counters
+
+MEMSIZE_MB = 1
+BUDGET = MEMSIZE_MB << 20
+NROWS = 10 * BUDGET // 16        # u64 key + u64 value = 16 B/row → ~10 pages
+
+
+def _fresh_counters():
+    c = global_counters()
+    c.msize = 0
+    c.msizemax = 0
+    return c
+
+
+def _big_mr(tmp_path, rng, nkey=5000):
+    mr = MapReduce(outofcore=1, memsize=MEMSIZE_MB, maxpage=1,
+                   fpath=str(tmp_path))
+    keys = rng.integers(0, nkey, NROWS).astype(np.uint64)
+    vals = rng.integers(0, 1 << 30, NROWS).astype(np.uint64)
+    # several map adds → several frames, most spilled
+    step = NROWS // 8
+    mr.map(1, lambda i, kv, p: [kv.add_batch(keys[s:s + step],
+                                             vals[s:s + step])
+                                for s in range(0, NROWS, step)])
+    return mr, keys, vals
+
+
+def test_external_sort_keys_bounded(tmp_path, rng):
+    mr, keys, vals = _big_mr(tmp_path, rng)
+    c = _fresh_counters()
+    mr.sort_keys(1)
+    assert c.msizemax <= 2.5 * BUDGET, f"peak {c.msizemax} vs {BUDGET}"
+    got_k = np.concatenate([np.asarray(f.key.data) for f in mr.kv.frames()])
+    got_v = np.concatenate([np.asarray(f.value.data) for f in mr.kv.frames()])
+    assert len(got_k) == NROWS
+    np.testing.assert_array_equal(got_k, np.sort(keys, kind="stable"))
+    # per-key value multisets survive (the external merge, like the
+    # reference's qsort, does not promise stability for duplicate keys)
+    order = np.lexsort((got_v, got_k))
+    oracle = np.lexsort((vals, keys))
+    np.testing.assert_array_equal(got_k[order], keys[oracle])
+    np.testing.assert_array_equal(got_v[order], vals[oracle])
+
+
+def test_external_sort_descending_bounded(tmp_path, rng):
+    mr, keys, vals = _big_mr(tmp_path, rng)
+    c = _fresh_counters()
+    mr.sort_values(-1)
+    assert c.msizemax <= 2.5 * BUDGET
+    got_k = np.concatenate([np.asarray(f.key.data) for f in mr.kv.frames()])
+    got_v = np.concatenate([np.asarray(f.value.data) for f in mr.kv.frames()])
+    assert len(got_v) == NROWS
+    # global descending order by value
+    assert (np.diff(got_v.astype(np.int64)) <= 0).all()
+    # (key, value) pairing survives the descending reshuffle
+    order = np.lexsort((got_k, got_v))
+    oracle = np.lexsort((keys, vals))
+    np.testing.assert_array_equal(got_v[order], vals[oracle])
+    np.testing.assert_array_equal(got_k[order], keys[oracle])
+
+
+def test_external_convert_giant_single_key(tmp_path, rng):
+    """All rows share one key: the whole dataset is one group — it must
+    come back as exactly one group (the extended-KMV contract), correct
+    even though the peak is O(group) by design."""
+    mr = MapReduce(outofcore=1, memsize=MEMSIZE_MB, maxpage=1,
+                   fpath=str(tmp_path))
+    n = 3 * BUDGET // 16
+    vals = np.arange(n, dtype=np.uint64)
+    step = n // 4
+    mr.map(1, lambda i, kv, p: [kv.add_batch(
+        np.full(step, 7, np.uint64), vals[s:s + step])
+        for s in range(0, n, step)])
+    mr.convert()
+    frames = list(mr.kmv.frames())
+    keys = [int(k) for f in frames for k in np.asarray(f.key.data)]
+    assert keys == [7]
+    total = sum(int(f.nvalues.sum()) for f in frames)
+    assert total == n
+
+
+def test_external_convert_reduce_bounded(tmp_path, rng):
+    mr, keys, vals = _big_mr(tmp_path, rng)
+    c = _fresh_counters()
+    mr.convert()
+    assert c.msizemax <= 2.5 * BUDGET, f"peak {c.msizemax} vs {BUDGET}"
+    assert mr.kmv.nframes > 1          # actually streamed in pieces
+    # group counts match a dict oracle; reduce streams frame by frame
+    import collections
+    oracle = collections.Counter(keys.tolist())
+    got = {}
+    mr.reduce(lambda k, vlist, kv, p: got.__setitem__(int(k), len(vlist)))
+    assert got == dict(oracle)
+    assert c.msizemax <= 2.5 * BUDGET
+
+
+def test_external_convert_groups_never_split(tmp_path, rng):
+    """Every key appears in exactly one KMV group across all frames."""
+    mr, keys, _ = _big_mr(tmp_path, rng, nkey=300)
+    _fresh_counters()
+    mr.convert()
+    seen = {}
+    for fr in mr.kmv.frames():
+        for i, k in enumerate(np.asarray(fr.key.data).tolist()):
+            assert k not in seen, f"key {k} split across frames"
+            seen[k] = int(fr.nvalues[i])
+    import collections
+    oracle = collections.Counter(keys.tolist())
+    assert seen == dict(oracle)
+
+
+def test_external_sort_multicolumn_keys(tmp_path, rng):
+    """Edge-style [n,2] u64 keys sort lexicographically out of core."""
+    mr = MapReduce(outofcore=1, memsize=MEMSIZE_MB, maxpage=1,
+                   fpath=str(tmp_path))
+    n = 3 * BUDGET // 24
+    e = rng.integers(0, 1000, (n, 2)).astype(np.uint64)
+    v = np.arange(n, dtype=np.uint64)
+    step = n // 4
+    mr.map(1, lambda i, kv, p: [kv.add_batch(e[s:s + step], v[s:s + step])
+                                for s in range(0, n, step)])
+    c = _fresh_counters()
+    mr.sort_keys(1)
+    assert c.msizemax <= 2.5 * BUDGET
+    got = np.concatenate([np.asarray(f.key.data) for f in mr.kv.frames()])
+    order = np.lexsort((e[:, 1], e[:, 0]))
+    np.testing.assert_array_equal(got, e[order])
