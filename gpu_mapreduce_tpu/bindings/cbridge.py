@@ -41,6 +41,16 @@ REDUCE_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
 SCAN_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
                            ctypes.POINTER(ctypes.c_char), ctypes.c_int,
                            ctypes.c_void_p)
+MAPCHUNK_FN = ctypes.CFUNCTYPE(None, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                               ctypes.c_void_p, ctypes.c_void_p)
+HASH_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int)
+CMP_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_char),
+                          ctypes.c_int, ctypes.POINTER(ctypes.c_char),
+                          ctypes.c_int)
+SCANKMV_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                              ctypes.POINTER(ctypes.c_int), ctypes.c_void_p)
 
 
 def _register(obj) -> int:
@@ -143,6 +153,79 @@ def mr_map_file_list(h: int, paths: List[bytes], fnptr: int, appptr: int,
 
     return mr.map_files([p.decode() for p in paths], wrapper,
                         addflag=addflag)
+
+
+def mr_map_file_chunks(h: int, which: str, nmap: int, paths: List[bytes],
+                       sep: bytes, delta: int, fnptr: int,
+                       appptr: int) -> int:
+    """Chunked file maps (reference MR_map_file_char/str): the C callback
+    receives each chunk's raw bytes."""
+    fn = MAPCHUNK_FN(fnptr)
+    mr = _get(h)
+
+    def wrapper(itask, chunk, kv, ptr):
+        acc = _KVAccum(kv)
+        kvh = _register(acc)
+        try:
+            buf = ctypes.create_string_buffer(bytes(chunk), len(chunk))
+            fn(itask, buf, len(chunk), kvh, appptr)
+            acc.flush()
+        finally:
+            _handles.pop(kvh, None)
+
+    files = [p.decode() for p in paths]
+    if which == "char":
+        return mr.map_file_char(nmap, files, 0, 0, sep, delta, wrapper)
+    return mr.map_file_str(nmap, files, 0, 0, sep, delta, wrapper)
+
+
+def mr_aggregate_hash(h: int, fnptr: int) -> int:
+    """MR_aggregate with a user C hash: proc = myhash(key, keybytes) %
+    nprocs, evaluated on the host per key (the reference calls it per
+    pair too, src/mapreduce.cpp:469-471)."""
+    fn = HASH_FN(fnptr)
+
+    def host_hash(key_bytes_list):
+        return np.asarray([fn(b, len(b)) for b in key_bytes_list],
+                          np.int64)
+
+    host_hash.host_hash = True
+    return _get(h).aggregate(host_hash)
+
+
+def _bytes_cmp(fnptr: int):
+    fn = CMP_FN(fnptr)
+
+    def cmp(a, b):
+        ab, bb = _to_bytes(a), _to_bytes(b)
+        return fn(ctypes.create_string_buffer(ab, len(ab)), len(ab),
+                  ctypes.create_string_buffer(bb, len(bb)), len(bb))
+
+    return cmp
+
+
+def mr_sort_cmp(h: int, which: str, fnptr: int) -> int:
+    mr = _get(h)
+    cmp = _bytes_cmp(fnptr)
+    if which == "keys":
+        return mr.sort_keys(cmp)
+    if which == "values":
+        return mr.sort_values(cmp)
+    return mr.sort_multivalues(cmp)
+
+
+def mr_scan_kmv(h: int, fnptr: int, appptr: int) -> int:
+    fn = SCANKMV_FN(fnptr)
+
+    def wrapper(k, vals, ptr):
+        kb = _to_bytes(k)
+        bvals = [_to_bytes(v) for v in vals]
+        mv = b"".join(bvals)
+        sizes = (ctypes.c_int * len(bvals))(*[len(b) for b in bvals])
+        buf = ctypes.create_string_buffer(mv, len(mv))
+        fn(kb, len(kb), buf, len(bvals), sizes, appptr)
+
+    return _get(h).scan_kmv(wrapper)
 
 
 def _call_reduce(fn, appptr, key, vals, kv):

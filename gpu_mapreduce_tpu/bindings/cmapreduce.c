@@ -155,6 +155,45 @@ uint64_t MR_map_file_list(void *mr, int nstr, char **paths,
   return n;
 }
 
+static uint64_t map_chunks(void *mr, const char *which, int nmap, int nstr,
+                           char **paths, const char *sep, int seplen,
+                           int delta, void (*fn)(int, char *, int, void *,
+                                                 void *),
+                           void *ptr) {
+  PyObject *list = PyList_New(nstr);
+  if (list == NULL) return 0;
+  for (int i = 0; i < nstr; i++)
+    PyList_SET_ITEM(list, i, PyBytes_FromString(paths[i]));
+  uint64_t n = as_u64(bridge_call("mr_map_file_chunks", "(nsiOy#inn)",
+                                  (Py_ssize_t)mr, which, nmap, list, sep,
+                                  (Py_ssize_t)seplen, delta,
+                                  (Py_ssize_t)(intptr_t)fn,
+                                  (Py_ssize_t)(intptr_t)ptr));
+  Py_DECREF(list);
+  return n;
+}
+
+uint64_t MR_map_file_char(void *mr, int nmap, int nstr, char **paths,
+                          char sepchar, int delta,
+                          void (*fn)(int, char *, int, void *, void *),
+                          void *ptr) {
+  return map_chunks(mr, "char", nmap, nstr, paths, &sepchar, 1, delta, fn,
+                    ptr);
+}
+
+uint64_t MR_map_file_str(void *mr, int nmap, int nstr, char **paths,
+                         const char *sepstr, int delta,
+                         void (*fn)(int, char *, int, void *, void *),
+                         void *ptr) {
+  return map_chunks(mr, "str", nmap, nstr, paths, sepstr,
+                    (int)strlen(sepstr), delta, fn, ptr);
+}
+
+uint64_t MR_aggregate_hash(void *mr, int (*myhash)(char *, int)) {
+  return as_u64(bridge_call("mr_aggregate_hash", "(nn)", (Py_ssize_t)mr,
+                            (Py_ssize_t)(intptr_t)myhash));
+}
+
 uint64_t MR_reduce(void *mr,
                    void (*fn)(char *, int, char *, int, int *, void *,
                               void *),
@@ -218,6 +257,38 @@ uint64_t MR_sort_keys_flag(void *mr, int flag) {
 uint64_t MR_sort_values_flag(void *mr, int flag) {
   return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
                             "sort_values", flag));
+}
+
+uint64_t MR_sort_multivalues_flag(void *mr, int flag) {
+  return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
+                            "sort_multivalues", flag));
+}
+
+static uint64_t sort_cmp(void *mr, const char *which,
+                         int (*cmp)(char *, int, char *, int)) {
+  return as_u64(bridge_call("mr_sort_cmp", "(nsn)", (Py_ssize_t)mr, which,
+                            (Py_ssize_t)(intptr_t)cmp));
+}
+
+uint64_t MR_sort_keys(void *mr, int (*cmp)(char *, int, char *, int)) {
+  return sort_cmp(mr, "keys", cmp);
+}
+
+uint64_t MR_sort_values(void *mr, int (*cmp)(char *, int, char *, int)) {
+  return sort_cmp(mr, "values", cmp);
+}
+
+uint64_t MR_sort_multivalues(void *mr,
+                             int (*cmp)(char *, int, char *, int)) {
+  return sort_cmp(mr, "multivalues", cmp);
+}
+
+uint64_t MR_scan_kmv(void *mr,
+                     void (*fn)(char *, int, char *, int, int *, void *),
+                     void *ptr) {
+  return as_u64(bridge_call("mr_scan_kmv", "(nnn)", (Py_ssize_t)mr,
+                            (Py_ssize_t)(intptr_t)fn,
+                            (Py_ssize_t)(intptr_t)ptr));
 }
 
 uint64_t MR_kv_stats(void *mr) {

@@ -45,8 +45,26 @@ uint64_t MR_map_file_list(void *mr, int nstr, char **paths,
                                         void *ptr),
                           void *ptr);
 
+/* chunked file maps (reference map_file_char/str variants,
+ * src/cmapreduce.h — callback receives one chunk of bytes ending on the
+ * separator, with `delta` lookahead trimmed) */
+uint64_t MR_map_file_char(void *mr, int nmap, int nstr, char **paths,
+                          char sepchar, int delta,
+                          void (*mymap)(int itask, char *bytes, int nbytes,
+                                        void *kv, void *ptr),
+                          void *ptr);
+uint64_t MR_map_file_str(void *mr, int nmap, int nstr, char **paths,
+                         const char *sepstr, int delta,
+                         void (*mymap)(int itask, char *bytes, int nbytes,
+                                       void *kv, void *ptr),
+                         void *ptr);
+
 /* shuffle / grouping / reduce */
 uint64_t MR_aggregate(void *mr);
+/* user hash: key → int; proc = hash % nprocs (reference MR_aggregate's
+ * myhash).  The callback runs on the host per key. */
+uint64_t MR_aggregate_hash(void *mr,
+                           int (*myhash)(char *key, int keybytes));
 uint64_t MR_convert(void *mr);
 uint64_t MR_collate(void *mr);
 uint64_t MR_clone(void *mr);
@@ -64,15 +82,28 @@ uint64_t MR_compress(void *mr,
                                       void *, void *),
                      void *ptr);
 
-/* sorts (flag semantics of the reference: ±1..6) */
+/* sorts (flag semantics of the reference: ±1..6; _cmp variants take the
+ * reference's appcompare over raw bytes) */
 uint64_t MR_sort_keys_flag(void *mr, int flag);
 uint64_t MR_sort_values_flag(void *mr, int flag);
+uint64_t MR_sort_multivalues_flag(void *mr, int flag);
+uint64_t MR_sort_keys(void *mr,
+                      int (*mycompare)(char *, int, char *, int));
+uint64_t MR_sort_values(void *mr,
+                        int (*mycompare)(char *, int, char *, int));
+uint64_t MR_sort_multivalues(void *mr,
+                             int (*mycompare)(char *, int, char *, int));
 
 /* read-only */
 uint64_t MR_scan_kv(void *mr,
                     void (*myscan)(char *key, int keybytes, char *value,
                                    int valuebytes, void *ptr),
                     void *ptr);
+uint64_t MR_scan_kmv(void *mr,
+                     void (*myscan)(char *key, int keybytes,
+                                    char *multivalue, int nvalues,
+                                    int *valuebytes, void *ptr),
+                     void *ptr);
 uint64_t MR_kv_stats(void *mr);
 uint64_t MR_kmv_stats(void *mr);
 int MR_print_file(void *mr, const char *path, int kflag, int vflag);

@@ -20,7 +20,7 @@ index), ``concat``, ``slice``, and conversion to/from host.
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
 import jax
@@ -146,7 +146,7 @@ class BytesColumn(Column):
         collision between distinct strings (probability ~n^2/2^64)."""
         strings = [bytes(s) for s in self.data]
         ids = hash_bytes64_batch(strings)
-        table: Dict[int, bytes] = {}
+        table = InternTable(kind="bytes")
         for h, s in zip(ids.tolist(), strings):
             prev = table.get(h)
             if prev is not None and prev != s:
@@ -158,13 +158,97 @@ class BytesColumn(Column):
         return f"BytesColumn<n={len(self)}>"
 
 
+class InternTable(dict):
+    """id→key table from Column.intern(); ``kind`` records whether the
+    decoded keys are raw bytes or arbitrary objects so the decode side
+    rebuilds the right column type (no first-row guessing)."""
+
+    def __init__(self, *a, kind: str = "bytes", **kw):
+        super().__init__(*a, **kw)
+        self.kind = kind
+
+
+class ObjectColumn(Column):
+    """Host column of ARBITRARY pickled python objects — the tier behind
+    the reference's Python wrapper, which cPickles any key/value into the
+    byte-packed KV (``python/mrmpi.py:17-45``, ``doc/Technical.txt:375-418``).
+
+    Rows compare/group/sort by their pickled bytes (exactly the
+    reference's semantics: the C++ core sees only the pickle), so keys
+    need not be hashable or orderable themselves."""
+
+    __slots__ = ("data", "_pickles")
+
+    def __init__(self, data: Sequence):
+        if isinstance(data, np.ndarray) and data.dtype == object:
+            self.data = data
+        else:
+            arr = np.empty(len(data), dtype=object)
+            for i, x in enumerate(data):
+                arr[i] = x
+            self.data = arr
+        self._pickles: Optional[List[bytes]] = None
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    def to_host(self) -> "ObjectColumn":
+        return self
+
+    def take(self, idx) -> "ObjectColumn":
+        return ObjectColumn(self.data[np.asarray(idx)])
+
+    def slice(self, start: int, stop: int) -> "ObjectColumn":
+        return ObjectColumn(self.data[start:stop])
+
+    def pickles(self) -> List[bytes]:
+        """Per-row pickles, computed once — nbytes/sort/intern all consume
+        these and a budget check per push must not re-pickle the world."""
+        if self._pickles is None:
+            import pickle
+            self._pickles = [pickle.dumps(x, protocol=4) for x in self.data]
+        return self._pickles
+
+    def nbytes(self) -> int:
+        return int(sum(len(p) for p in self.pickles()))
+
+    def tolist(self) -> list:
+        return self.data.tolist()
+
+    def intern(self) -> tuple:
+        """Objects → u64 ids via their pickles (see BytesColumn.intern);
+        the id→object table stays controller-side."""
+        pk = self.pickles()
+        ids = hash_bytes64_batch(pk)
+        table = InternTable(kind="object")
+        seen: Dict[int, bytes] = {}
+        for h, p, obj in zip(ids.tolist(), pk, self.data):
+            prev = seen.get(h)
+            if prev is not None and prev != p:
+                raise ValueError("64-bit intern collision between objects")
+            seen[h] = p
+            table[h] = obj
+        return DenseColumn(ids), table
+
+    def __repr__(self):
+        return f"ObjectColumn<n={len(self)}>"
+
+
 def concat(cols: List[Column]) -> Column:
     cols = [c for c in cols if len(c) > 0] or cols[:1]
     if len(cols) == 1:
         return cols[0]
+    if any(isinstance(c, ObjectColumn) for c in cols):
+        # bytes are picklable objects: a mix of Bytes/Object frames (from
+        # separate add-buffer flushes) promotes to the object tier
+        if not all(isinstance(c, (ObjectColumn, BytesColumn))
+                   for c in cols):
+            raise TypeError("cannot concat object rows with numeric rows")
+        return ObjectColumn(np.concatenate([c.data for c in cols]))
     first = cols[0]
     if isinstance(first, BytesColumn):
-        assert all(isinstance(c, BytesColumn) for c in cols)
+        if not all(isinstance(c, BytesColumn) for c in cols):
+            raise TypeError("cannot concat byte rows with numeric rows")
         return BytesColumn(np.concatenate([c.data for c in cols]))
     assert all(isinstance(c, DenseColumn) for c in cols)
     if any(_is_device(c.data) for c in cols):

@@ -59,8 +59,20 @@ class _Spilled:
 
 
 def _col_to_npz(col: Column, prefix: str, out: dict):
-    if isinstance(col, BytesColumn):
-        out[prefix + "_obj"] = col.data
+    """Spill one column into npz payload entries.  numpy ≥ 2 refuses to
+    save object arrays, so byte strings flatten to buffer+offsets and
+    arbitrary objects to one pickle blob (the reference's pages are raw
+    bytes on disk too)."""
+    from .column import ObjectColumn
+    if isinstance(col, ObjectColumn):
+        import pickle
+        blob = pickle.dumps(col.data.tolist(), protocol=4)
+        out[prefix + "_pobj"] = np.frombuffer(blob, np.uint8)
+    elif isinstance(col, BytesColumn):
+        rows = [bytes(b) for b in col.data]
+        out[prefix + "_obj"] = np.frombuffer(b"".join(rows), np.uint8)
+        out[prefix + "_obj_off"] = np.concatenate(
+            [[0], np.cumsum([len(b) for b in rows])]).astype(np.int64)
     else:
         out[prefix + "_arr"] = np.asarray(col.data)
 
@@ -83,8 +95,15 @@ def _spill_budget(settings: Settings) -> int:
 
 
 def _col_from_npz(z, prefix: str) -> Column:
+    if prefix + "_pobj" in z:
+        import pickle
+        from .column import ObjectColumn
+        return ObjectColumn(pickle.loads(z[prefix + "_pobj"].tobytes()))
     if prefix + "_obj" in z:
-        return BytesColumn(z[prefix + "_obj"])
+        buf = z[prefix + "_obj"].tobytes()
+        off = z[prefix + "_obj_off"]
+        return BytesColumn([buf[off[i]:off[i + 1]]
+                            for i in range(len(off) - 1)])
     return DenseColumn(z[prefix + "_arr"])
 
 
@@ -385,17 +404,29 @@ def rows_to_array(rows: list) -> np.ndarray:
 
 def _coerce_rows(rows: list) -> Column:
     """Turn a python append buffer into a column: bytes→BytesColumn,
-    numbers/tuples→DenseColumn."""
+    numbers/uniform tuples→DenseColumn, anything else (dicts, mixed
+    types, ragged tuples…)→ObjectColumn — the pickle tier matching the
+    reference Python wrapper's arbitrary-object KVs
+    (python/mrmpi.py:17-45)."""
+    from .column import ObjectColumn
     first = rows[0]
     if isinstance(first, (bytes, str, bytearray)):
-        return BytesColumn([r if isinstance(r, bytes) else
-                            (r.encode() if isinstance(r, str) else bytes(r))
-                            for r in rows])
+        try:
+            return BytesColumn([r if isinstance(r, bytes) else
+                                (r.encode() if isinstance(r, str)
+                                 else bytes(r)) for r in rows])
+        except (AttributeError, TypeError):
+            return ObjectColumn(rows)
     if first is None:
         return DenseColumn(np.zeros(len(rows), dtype=np.uint8))
-    arr = rows_to_array(rows)
-    if arr.dtype == object:
-        raise TypeError("mixed-type rows in KV add buffer")
+    try:
+        arr = rows_to_array(rows)
+    except (ValueError, OverflowError):
+        return ObjectColumn(rows)
+    if arr.dtype == object or arr.dtype.kind in "USV":
+        # numpy stringifies mixed tuples like ('a', 1) — those are
+        # arbitrary objects, not data; keep the originals via pickle
+        return ObjectColumn(rows)
     return DenseColumn(arr)
 
 

@@ -46,7 +46,11 @@ _OBJ = np.dtype(object)
 def sort_surrogate(col: Column) -> np.ndarray:
     """A 1-D array whose ascending order is the column's sort order:
     numeric 1-D columns as-is; multi-column rows as structured records
-    (field-lexicographic comparison); byte strings as object rows."""
+    (field-lexicographic comparison); byte strings as object rows;
+    arbitrary objects by their pickles (argsort_column's order)."""
+    from .column import ObjectColumn
+    if isinstance(col, ObjectColumn):
+        return np.asarray(col.pickles(), dtype=object)
     if isinstance(col, BytesColumn):
         return np.asarray(list(col.data), dtype=object)
     data = np.asarray(col.to_host().data)
@@ -67,29 +71,35 @@ class _Run:
     cannot mmap; they spill pickled and re-read whole per refill — the
     rare path, only for string-keyed out-of-core sorts."""
 
-    def __init__(self, kpath: str, vpath: str, n: int, counters):
+    def __init__(self, kpath: str, vpath: str, n: int, counters,
+                 kkind: str, vkind: str):
         self.kpath = kpath
         self.vpath = vpath
         self.n = n
         self.pos = 0
         self.counters = counters
+        self.kkind = kkind   # "dense" | "bytes" | "object" (column type
+        self.vkind = vkind   # is recorded, never guessed from row values)
         self.buf: Optional[KVFrame] = None
         self.sur: Optional[np.ndarray] = None
 
-    def _load(self, path: str, start: int, stop: int) -> Column:
-        try:
+    def _load(self, path: str, start: int, stop: int, kind: str) -> Column:
+        if kind == "dense":
             arr = np.load(path, mmap_mode="r")
             return DenseColumn(np.array(arr[start:stop]))
-        except ValueError:  # object array: pickled, no mmap
-            arr = np.load(path, allow_pickle=True)
-            return BytesColumn(arr[start:stop])
+        arr = np.load(path, allow_pickle=True)[start:stop]
+        if kind == "object":
+            from .column import ObjectColumn
+            return ObjectColumn(arr)
+        return BytesColumn(arr)
 
     def refill(self, block_rows: int, by: str):
         if self.buf is not None or self.pos >= self.n:
             return
         stop = min(self.pos + block_rows, self.n)
-        self.buf = KVFrame(self._load(self.kpath, self.pos, stop),
-                           self._load(self.vpath, self.pos, stop))
+        self.buf = KVFrame(
+            self._load(self.kpath, self.pos, stop, self.kkind),
+            self._load(self.vpath, self.pos, stop, self.vkind))
         self.sur = sort_surrogate(self.buf.key if by == "key"
                                   else self.buf.value)
         self.counters.rsize += self.buf.nbytes()
@@ -124,22 +134,34 @@ class _Run:
                 pass
 
 
+def _col_kind(col: Column) -> str:
+    from .column import ObjectColumn
+    if isinstance(col, ObjectColumn):
+        return "object"
+    if isinstance(col, BytesColumn):
+        return "bytes"
+    return "dense"
+
+
 def _save_col(col: Column, path: str):
-    data = (np.asarray(list(col.data), dtype=object)
-            if isinstance(col, BytesColumn)
-            else np.asarray(col.to_host().data))
-    np.save(path, data, allow_pickle=isinstance(col, BytesColumn))
+    if _col_kind(col) == "dense":
+        np.save(path, np.asarray(col.to_host().data))
+    else:
+        np.save(path, np.asarray(list(col.data), dtype=object),
+                allow_pickle=True)
 
 
 def _write_run(fr: KVFrame, settings, counters, seq: int) -> _Run:
+    from .dataset import _next_file_id
     os.makedirs(settings.fpath, exist_ok=True)
     base = os.path.join(settings.fpath,
-                        f"mrtpu.sortrun.{id(settings) & 0xFFFF}.{seq}")
+                        f"mrtpu.sortrun.{_next_file_id()}.{seq}")
     kpath, vpath = base + ".k.npy", base + ".v.npy"
     _save_col(fr.key, kpath)
     _save_col(fr.value, vpath)
     counters.wsize += fr.nbytes()
-    return _Run(kpath, vpath, len(fr), counters)
+    return _Run(kpath, vpath, len(fr), counters,
+                _col_kind(fr.key), _col_kind(fr.value))
 
 
 def external_sorted_chunks(frames: Iterator[KVFrame], by: str,
@@ -156,13 +178,15 @@ def external_sorted_chunks(frames: Iterator[KVFrame], by: str,
     # as a run
     from ..ops.sort import argsort_column
     runs: List[_Run] = []
-    rowbytes = 64
+    rowbytes = 16
     for seq, fr in enumerate(frames):
         col = fr.key if by == "key" else fr.value
         order = argsort_column(col)
         runs.append(_write_run(fr.take(order), settings, counters, seq))
         if len(fr):
-            rowbytes = max(1, fr.nbytes() // len(fr))
+            # size blocks for the WIDEST rows seen, or a fat-row run's
+            # refills would blow the budget the merge exists to bound
+            rowbytes = max(rowbytes, fr.nbytes() // len(fr))
 
     if not runs:
         return

@@ -143,6 +143,51 @@ class MapReduce:
         self._last_stats = {"op": op, **kw}
         if self.settings.verbosity:
             self.kv_stats(self.settings.verbosity, _op=op)
+            if self.settings.verbosity >= 2 and self._op_snap is not None:
+                c = self.counters
+                w0, r0, s0 = self._op_snap
+                dw, dr, ds = c.wsize - w0, c.rsize - r0, c.cssize - s0
+                if dw or dr or ds:
+                    print(f"  {op} I/O: {dw / (1 << 20):.3g} Mb spilled, "
+                          f"{dr / (1 << 20):.3g} Mb re-read, "
+                          f"{ds / (1 << 20):.3g} Mb shuffled")
+        self._op_snap = None
+
+    _op_snap = None
+
+    def _begin_op(self) -> Timer:
+        """Per-op start: timer + counter snapshot for verbosity=2 deltas
+        (the reference's file_stats/stats per-op reporting,
+        src/mapreduce.cpp:3112-3226)."""
+        c = self.counters
+        self._op_snap = (c.wsize, c.rsize, c.cssize)
+        return Timer()
+
+    def _shard_counts(self, which: str = "kv"):
+        """Per-shard row counts: mesh datasets report real shard counts;
+        host datasets report one value per frame (the serial 'procs')."""
+        ds = self.kv if which == "kv" else self.kmv
+        if ds is None:
+            return []
+        out = []
+        for f in ds._frames:
+            counts = getattr(f, "gcounts" if which == "kmv" else "counts",
+                             None)
+            if counts is not None:
+                out.extend(int(x) for x in counts)
+            else:
+                out.append(f.n if hasattr(f, "n") else len(f))
+        return out
+
+    def _tier_note(self, op: str, fr) -> None:
+        """verbosity≥2: say which tier an op ran on — a silent fall to the
+        host per-pair path is a 1000× slowdown the user should see."""
+        if self.settings.verbosity >= 2:
+            from .frame import KMVFrame, KVFrame as _KVF
+            host = isinstance(fr, (KMVFrame, _KVF))
+            n = len(fr)
+            print(f"  {op}: {'host per-row' if host else 'device batch'} "
+                  f"tier ({n} rows)")
 
     # ------------------------------------------------------------------
     # map family (reference src/mapreduce.cpp:1044-1642)
@@ -153,7 +198,7 @@ class MapReduce:
         src/mapreduce.cpp:1044-1225).  mapstyle chunk/stride both reduce to
         'all tasks' under one controller; style 2 (master-slave) degrades to
         chunk (SURVEY.md §7)."""
-        t = Timer()
+        t = self._begin_op()
         kv = self._start_map(addflag)
         for itask in range(nmap):
             func(itask, kv, ptr)
@@ -167,7 +212,7 @@ class MapReduce:
         """File map: func(itask, filename, kv, ptr) per file (reference
         map(nstr,strings,self,recurse,readflag,func,ptr,addflag),
         src/mapreduce.cpp:1060-1092)."""
-        t = Timer()
+        t = self._begin_op()
         if isinstance(files, str):
             files = [files]
         names = findfiles(files, bool(recurse), bool(readflag))
@@ -197,7 +242,7 @@ class MapReduce:
 
     def _map_chunks(self, nmap, files, recurse, readflag, sep, delta,
                     func, ptr, addflag) -> int:
-        t = Timer()
+        t = self._begin_op()
         if isinstance(files, str):
             files = [files]
         names = findfiles(files, bool(recurse), bool(readflag))
@@ -221,7 +266,7 @@ class MapReduce:
 
         host path: func(itask, key, value, kv, ptr) per pair;
         batch path: func(frame, kv, ptr) per KVFrame (vectorised)."""
-        t = Timer()
+        t = self._begin_op()
         src = mr._require_kv("map over")
         src_frames = list(src.frames())  # snapshot supports self-map
         kv = self._start_map(addflag)
@@ -245,7 +290,7 @@ class MapReduce:
         """THE shuffle: each key to one proc — user hash or
         hashlittle(key)%nprocs (reference src/mapreduce.cpp:385-563;
         call stack SURVEY.md §3.2).  Serial backend: no-op."""
-        t = Timer()
+        t = self._begin_op()
         kv = self._require_kv("aggregate")
         self.backend.aggregate(self, hash_fn)
         self._op_stats("aggregate", nkv=kv.nkv)
@@ -288,7 +333,7 @@ class MapReduce:
         out-of-core multi-frame dataset streams: external sort runs →
         k-way merge → group-boundary frame cuts, in ~one page budget of
         memory (the Spool cascade's job, src/mapreduce.cpp:2359-2633)."""
-        t = Timer()
+        t = self._begin_op()
         kv = self._require_kv("convert")
         self.kmv = self._new_kmv()
         if self._use_external(kv):
@@ -376,15 +421,18 @@ class MapReduce:
         multivalue_blocks(), src/mapreduce.cpp:1874-1925).  Callbacks use
         ``iter_blocks(mv)`` to handle both uniformly; setting it tiny is
         the ONEMAX stress hook (src/keymultivalue.cpp:43-45)."""
-        t = Timer()
+        t = self._begin_op()
         kmv = self._require_kmv("reduce")
         kv = self._new_kv()
         for fr in kmv.frames():
             if batch:
+                self._tier_note("reduce(batch)", fr)
                 func(fr, kv, ptr)
             elif block_rows is not None:
                 self._reduce_blocked(fr, func, kv, ptr, block_rows)
             else:
+                if self.settings.verbosity >= 2:
+                    print(f"  reduce: host per-group tier ({len(fr)} groups)")
                 for k, vals in fr.groups():
                     func(k, vals, kv, ptr)
         kmv.free()
@@ -485,7 +533,7 @@ class MapReduce:
         return self._sort_kv(by="value", flag_or_cmp=flag_or_cmp)
 
     def _sort_kv(self, by: str, flag_or_cmp) -> int:
-        t = Timer()
+        t = self._begin_op()
         kv = self._require_kv(f"sort_{by}s")
         if not callable(flag_or_cmp) and self._use_external(kv):
             return self._sort_kv_external(kv, by, flag_or_cmp < 0, t)
@@ -540,7 +588,7 @@ class MapReduce:
     def sort_multivalues(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Sort values *within* each multivalue (reference
         src/mapreduce.cpp:2210-2352)."""
-        t = Timer()
+        t = self._begin_op()
         kmv = self._require_kmv("sort_multivalues")
         new = self._new_kmv()
         for fr in kmv.frames():
@@ -616,6 +664,9 @@ class MapReduce:
     # stats (reference src/mapreduce.cpp:2937-3066)
     # ------------------------------------------------------------------
     def kv_stats(self, level: int = 0, _op: str = "") -> tuple:
+        """Global pair/byte counts; level ≥ 2 adds the per-shard histogram
+        (reference kv_stats verbosity=2, src/mapreduce.cpp:2937-2968 via
+        write_histo — how imbalance/corruption is detected)."""
         kv = self.kv
         if kv is None:
             return (0, 0)
@@ -624,6 +675,9 @@ class MapReduce:
         if level:
             print(f"{n} pairs, {nb / (1 << 20):.3g} Mb of KV data "
                   f"{('after ' + _op) if _op else ''}".rstrip())
+            if level >= 2:
+                from .runtime import write_histo
+                write_histo("KV pairs", self._shard_counts("kv"))
         return (n, nb)
 
     def kmv_stats(self, level: int = 0) -> tuple:
@@ -635,6 +689,9 @@ class MapReduce:
         nb = int(self.backend.allreduce_sum(kmv.nbytes()))
         if level:
             print(f"{g} pairs, {n} values, {nb / (1 << 20):.3g} Mb of KMV data")
+            if level >= 2:
+                from .runtime import write_histo
+                write_histo("KMV groups", self._shard_counts("kmv"))
         return (g, n, nb)
 
     def cummulative_stats(self, level: int = 1, reset: int = 0):
@@ -656,6 +713,14 @@ class MapReduce:
             self.counters.commtime += dt
         if self.settings.timer:
             print(f"{op} time (secs) = {dt:.6g}")
+            if self.settings.timer >= 2:
+                # the controller orchestrates, so per-shard TIME is not
+                # observable the way the reference's per-proc barriers are
+                # (src/mapreduce.cpp:3112-3128); the per-shard ROW histogram
+                # is the imbalance signal that histogram exposed
+                from .runtime import write_histo
+                which = "kv" if self.kv is not None else "kmv"
+                write_histo(f"{op} rows", self._shard_counts(which))
 
 
 # ---------------------------------------------------------------------------

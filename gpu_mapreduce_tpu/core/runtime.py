@@ -99,6 +99,35 @@ class Timer:
         return time.perf_counter() - self.t0
 
 
+def histogram(values, nbins: int = 10):
+    """(min, avg, max, bins) over per-shard values — the reference's
+    histogram() (src/mapreduce.cpp:3267-3311): bins count how many shards
+    fall in each equal-width slice of [min, max]."""
+    import numpy as _np
+    v = _np.asarray(values, dtype=_np.float64)
+    if v.size == 0:
+        return 0.0, 0.0, 0.0, [0] * nbins
+    lo, hi = float(v.min()), float(v.max())
+    if hi == lo:
+        bins = [0] * nbins
+        bins[0] = int(v.size)
+        return lo, float(v.mean()), hi, bins
+    idx = _np.minimum(((v - lo) / (hi - lo) * nbins).astype(int), nbins - 1)
+    bins = _np.bincount(idx, minlength=nbins).astype(int).tolist()
+    return lo, float(v.mean()), hi, bins
+
+
+def write_histo(label: str, values, out=None):
+    """Reference write_histo (src/mapreduce.cpp:3251-3263): one line of
+    min/avg/max across shards plus the shard-count distribution."""
+    import sys as _sys
+    lo, ave, hi, bins = histogram(values)
+    out = out or _sys.stdout
+    out.write(f"  {label} (per shard): {ave:.4g} ave {hi:.4g} max "
+              f"{lo:.4g} min\n")
+    out.write("  histogram: " + " ".join(str(b) for b in bins) + "\n")
+
+
 _GLOBAL_COUNTERS = Counters()
 
 

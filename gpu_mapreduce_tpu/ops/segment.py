@@ -72,7 +72,28 @@ def group_bytes(kv: KVFrame) -> KMVFrame:
     return KMVFrame(key_col, nvalues, offsets, svals)
 
 
+def group_objects(kv: KVFrame) -> KMVFrame:
+    """Convert with arbitrary-object keys: group by PICKLE equality (the
+    reference's Python wrapper groups by pickled bytes — the C++ core
+    only ever sees the pickle, python/mrmpi.py:17-45)."""
+    from ..core.column import ObjectColumn
+    groups: dict = {}
+    firsts: dict = {}
+    for i, p in enumerate(kv.key.pickles()):
+        groups.setdefault(p, []).append(i)
+        firsts.setdefault(p, i)
+    idx = np.asarray([i for ids in groups.values() for i in ids],
+                     dtype=np.int64)
+    nvalues = np.asarray([len(v) for v in groups.values()], dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(nvalues)]).astype(np.int64)
+    ukeys = ObjectColumn([kv.key.data[firsts[p]] for p in groups])
+    return KMVFrame(ukeys, nvalues, offsets, kv.value.take(idx))
+
+
 def group_frame(kv: KVFrame) -> KMVFrame:
+    from ..core.column import ObjectColumn
+    if isinstance(kv.key, ObjectColumn):
+        return group_objects(kv)
     if kv.is_dense():
         return group_dense(kv)
     return group_bytes(kv)

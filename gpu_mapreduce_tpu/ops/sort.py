@@ -40,6 +40,13 @@ def argsort_column(col: Column, descending: bool = False,
         rows = col.tolist()
         order = sorted(range(n), key=lambda i: rows[i], reverse=descending)
         return np.asarray(order, dtype=np.int64)
+    from ..core.column import ObjectColumn
+    if isinstance(col, ObjectColumn):
+        # arbitrary objects order by their pickles (the bytes the
+        # reference's C++ comparators would see)
+        rows = col.pickles()
+        order = sorted(range(n), key=lambda i: rows[i], reverse=descending)
+        return np.asarray(order, dtype=np.int64)
     data = col.data
     if isinstance(data, jax.Array):
         if data.ndim == 1:

@@ -72,4 +72,5 @@ def broadcast_kv(backend, mr, root: int):
     moved = int(skv.counts[root]) * (backend.nprocs - 1) * rowbytes
     mr.counters.cssize += moved
     mr.counters.crsize += moved
-    _replace_kv_frames(mr.kv, ShardedKV(mesh, k, v, counts))
+    _replace_kv_frames(mr.kv, ShardedKV(mesh, k, v, counts,
+                                        key_decode=skv.key_decode))

@@ -142,7 +142,19 @@ def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
                                    row_sharding(a.mesh))
     k, v, c = _concat_jit(a.mesh)(a.key, a.value, put(a), b.key, b.value,
                                   put(b))
-    return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32))
+    if (a.key_decode is None) != (b.key_decode is None):
+        raise ValueError(
+            "cannot add an interned byte/object-keyed mesh dataset to a "
+            "plain-keyed one: the merged keys would span two key spaces")
+    kd = a.key_decode
+    if b.key_decode:
+        from ..core.column import InternTable
+        kind = ("object" if "object" in (
+            getattr(a.key_decode, "kind", "bytes"),
+            getattr(b.key_decode, "kind", "bytes")) else "bytes")
+        kd = InternTable({**a.key_decode, **b.key_decode}, kind=kind)
+    return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32),
+                     key_decode=kd)
 
 
 def clone_sharded(skv: ShardedKV) -> ShardedKMV:

@@ -108,7 +108,7 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
     nvalues = jax.device_put(nvalues, row_sharding(mesh))
     voffsets = jax.device_put(voffsets, row_sharding(mesh))
     return ShardedKMV(skv.mesh, ukey, nvalues, voffsets, svalue,
-                      gcounts, skv.counts.copy())
+                      gcounts, skv.counts.copy(), key_decode=skv.key_decode)
 
 
 def _clamp_sizes(nvalues, voffsets, gcounts, vcounts, gcap):
@@ -194,7 +194,8 @@ def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
     vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32),
                                  row_sharding(kmv.mesh))
     ukey, out = run(kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values, vcounts_dev)
-    return ShardedKV(kmv.mesh, ukey, out, kmv.gcounts.copy())
+    return ShardedKV(kmv.mesh, ukey, out, kmv.gcounts.copy(),
+                     key_decode=kmv.key_decode)
 
 
 def _bmask(valid, x):
@@ -231,7 +232,8 @@ def _first_jit(mesh):
 def first_sharded(kmv: ShardedKMV) -> ShardedKV:
     """One output pair per group with the group's FIRST value (dedupe/cull)."""
     uk, v = _first_jit(kmv.mesh)(kmv.ukey, kmv.voffsets, kmv.values)
-    return ShardedKV(kmv.mesh, uk, v, kmv.gcounts.copy())
+    return ShardedKV(kmv.mesh, uk, v, kmv.gcounts.copy(),
+                     key_decode=kmv.key_decode)
 
 
 @functools.lru_cache(maxsize=None)
@@ -265,7 +267,8 @@ def sort_multivalues_sharded(kmv: ShardedKMV,
     values = _sortmv_jit(kmv.mesh, descending)(
         kmv.voffsets, kmv.nvalues, kmv.values, vcounts_dev)
     return ShardedKMV(kmv.mesh, kmv.ukey, kmv.nvalues, kmv.voffsets, values,
-                      kmv.gcounts.copy(), kmv.vcounts.copy())
+                      kmv.gcounts.copy(), kmv.vcounts.copy(),
+                      key_decode=kmv.key_decode)
 
 
 def _desc_key(v):
@@ -306,4 +309,5 @@ def sort_sharded(skv: ShardedKV, by: str = "key",
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(skv.mesh))
     k, v = _sort_jit(skv.mesh, by, descending)(skv.key, skv.value, counts_dev)
-    return ShardedKV(skv.mesh, k, v, skv.counts.copy())
+    return ShardedKV(skv.mesh, k, v, skv.counts.copy(),
+                     key_decode=skv.key_decode)

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from ..core.column import DenseColumn
+from ..core.column import BytesColumn, DenseColumn
 from ..core.frame import KMVFrame, KVFrame
 from .mesh import mesh_axis_size, row_sharding
 
@@ -50,6 +50,17 @@ class ToHostStats:
         return (cls.kv - snap[0], cls.kmv - snap[1])
 
 
+def _decode_col(table: dict, ids: np.ndarray):
+    """id→key decode: the InternTable's kind (not a first-row guess)
+    selects bytes vs object column — an object table may legitimately
+    hold bytes rows."""
+    from ..core.column import ObjectColumn
+    rows = [table[int(h)] for h in ids]
+    if getattr(table, "kind", "bytes") == "object":
+        return ObjectColumn(rows)
+    return BytesColumn(rows)
+
+
 def round_cap(n: int) -> int:
     """Round a per-shard capacity up to a power of two (min 8) to bound
     the number of distinct compiled shapes."""
@@ -69,12 +80,18 @@ def _pad_rows(arr: np.ndarray, cap: int) -> np.ndarray:
 
 @dataclass
 class ShardedKV:
-    """Sharded KV frame: key/value row blocks + per-shard counts."""
+    """Sharded KV frame: key/value row blocks + per-shard counts.
+
+    ``key_decode`` (optional): id→bytes table when the keys are interned
+    byte strings (the device shuffle moves u64 ids; the bytes live on the
+    controller — SURVEY.md §7 "hard parts").  ``to_host`` resurrects the
+    byte keys so host callbacks/printing see the original strings."""
 
     mesh: Mesh
     key: jax.Array        # [P*cap] or [P*cap, w]
     value: jax.Array      # [P*cap] or [P*cap, w]
     counts: np.ndarray    # host [P] int32
+    key_decode: dict = None
 
     @property
     def nprocs(self) -> int:
@@ -106,7 +123,9 @@ class ShardedKV:
         keep = np.concatenate([np.arange(i * cap, i * cap + int(self.counts[i]))
                                for i in range(P)]) if len(self) else \
             np.zeros(0, np.int64)
-        return KVFrame(DenseColumn(k[keep]), DenseColumn(v[keep]))
+        key_col = (_decode_col(self.key_decode, k[keep])
+                   if self.key_decode is not None else DenseColumn(k[keep]))
+        return KVFrame(key_col, DenseColumn(v[keep]))
 
     def pairs(self) -> Iterator[Tuple[object, object]]:
         yield from self.to_host().pairs()
@@ -131,6 +150,7 @@ class ShardedKMV:
     values: jax.Array     # [P*vcap(, w)]
     gcounts: np.ndarray   # host [P]
     vcounts: np.ndarray   # host [P]
+    key_decode: dict = None   # see ShardedKV.key_decode
 
     @property
     def nprocs(self) -> int:
@@ -176,6 +196,8 @@ class ShardedKMV:
             [np.arange(i * gcap, i * gcap + int(self.gcounts[i]))
              for i in range(P)]) if len(self) else np.zeros(0, np.int64))
         key = uk[gkeep]
+        key_col = (_decode_col(self.key_decode, key)
+                   if self.key_decode is not None else None)
         nvalues = nv[gkeep].astype(np.int64)
         # global row index of each group's value run, then one ragged gather
         shard_of = gkeep // gcap
@@ -185,8 +207,8 @@ class ShardedKMV:
         idx = (np.repeat(starts - offsets[:-1], nvalues)
                + np.arange(total, dtype=np.int64))
         values = vals[idx]
-        return KMVFrame(DenseColumn(key), nvalues, offsets,
-                        DenseColumn(values))
+        return KMVFrame(key_col if key_col is not None else DenseColumn(key),
+                        nvalues, offsets, DenseColumn(values))
 
     def groups(self):
         yield from self.to_host().groups()
@@ -203,19 +225,29 @@ def shard_frame(frame: KVFrame, mesh: Mesh) -> ShardedKV:
     """Initial block distribution of a host/device KVFrame over the mesh
     (contiguous split — the analogue of 'each rank mapped its own tasks')."""
     P = mesh_axis_size(mesh)
+    n = len(frame)
+    per = -(-n // P) if n else 0
+    starts = np.minimum(np.arange(P) * per, n)
+    ends = np.minimum(starts + per, n)
+    return shard_frame_with_counts(frame, mesh,
+                                   (ends - starts).astype(np.int32))
+
+
+def shard_frame_with_counts(frame: KVFrame, mesh: Mesh,
+                            counts: np.ndarray) -> ShardedKV:
+    """Place a host frame on the mesh with an EXPLICIT partition: shard i
+    gets the next counts[i] consecutive rows (callers order rows first —
+    the host-hash aggregate path)."""
+    P = mesh_axis_size(mesh)
     k = np.asarray(frame.key.data)
     v = np.asarray(frame.value.data)
-    n = k.shape[0]
-    per = -(-n // P) if n else 0
-    cap = round_cap(per)
-    counts = np.zeros(P, np.int32)
+    cap = round_cap(int(counts.max()) if len(frame) else 0)
+    offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
     kb, vb = [], []
     for i in range(P):
-        lo, hi = min(i * per, n), min((i + 1) * per, n)
-        counts[i] = hi - lo
-        kb.append(_pad_rows(k[lo:hi], cap))
-        vb.append(_pad_rows(v[lo:hi], cap))
+        kb.append(_pad_rows(k[offs[i]:offs[i + 1]], cap))
+        vb.append(_pad_rows(v[offs[i]:offs[i + 1]], cap))
     sharding = row_sharding(mesh)
     key = jax.device_put(np.concatenate(kb), sharding)
     value = jax.device_put(np.concatenate(vb), sharding)
-    return ShardedKV(mesh, key, value, counts)
+    return ShardedKV(mesh, key, value, counts.astype(np.int32))

