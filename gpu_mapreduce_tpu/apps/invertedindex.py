@@ -95,12 +95,44 @@ def _build_corpus(files: Sequence[str]):
     return corpus, starts.astype(np.int32)
 
 
+_W_SHORT = 16      # 64-byte first-tier URL window (covers typical URLs)
+
+
 @functools.lru_cache(maxsize=None)
 def _extract_fn(cap: int, use_pallas: bool, interpret: bool):
     """The fused map stage (see module docstring).  jit re-specialises per
-    (corpus words, nfiles) shape; `cap` is the static hit capacity."""
+    (corpus words, nfiles) shape; `cap` is the static hit capacity.
+
+    The URL window gather is the dominant cost (~26 ns per gathered lane
+    on v5e), so it is TWO-TIER: a 64-byte window first — enough for
+    almost every real URL — then a second 256-byte gather over only the
+    rows whose closing quote was not in the first window.  A long-tail
+    overflow (more than cap/4 such rows) is returned so the caller can
+    retry with the full window for every row."""
+    return _extract_build(cap, use_pallas, interpret, wide=False)
+
+
+@functools.lru_cache(maxsize=None)
+def _extract_wide_fn(cap: int, use_pallas: bool, interpret: bool):
+    """Fallback: full 256-byte windows for every row (used when the
+    long-tail capacity overflows — long-URL-dense corpora)."""
+    return _extract_build(cap, use_pallas, interpret, wide=True)
+
+
+def _extract_build(cap: int, use_pallas: bool, interpret: bool, wide: bool):
     bs = min(_BS, cap)
     nw = MAX_URL // 4
+    w1 = nw if wide else _W_SHORT
+    cap_long = max(8, cap // 4)
+
+    def _hash2(win, length):
+        l0 = jnp.maximum(length, 0)
+        wm = mask_words_to_length(win, l0)
+        ids = hash_bytes64_masked(wm, l0)
+        # independent id family: any real u64 intern collision shows as
+        # one id with two alt-ids (checked after packing, no bytes kept)
+        alt = hash_bytes64_masked(wm, l0, 0x9E3779B9, 0x85EBCA6B)
+        return ids, alt
 
     @jax.jit
     def run(words, file_starts):
@@ -112,30 +144,65 @@ def _extract_fn(cap: int, use_pallas: bool, interpret: bool):
         ustarts = starts + np.int32(len(PATTERN))
 
         def body(st):
-            win = unaligned_words(words, st, nw)
+            win = unaligned_words(words, st, w1)
             length = first_byte_pos(win, QUOTE)
-            l0 = jnp.maximum(length, 0)
-            wm = mask_words_to_length(win, l0)
-            ids = hash_bytes64_masked(wm, l0)
-            # independent id family: any real u64 intern collision shows as
-            # one id with two alt-ids (checked after packing, no bytes kept)
-            alt = hash_bytes64_masked(wm, l0, 0x9E3779B9, 0x85EBCA6B)
+            ids, alt = _hash2(win, length)
             return ids, alt, length
 
         ids, alts, lengths = lax.map(body, ustarts.reshape(-1, bs))
         ids = ids.reshape(-1)
         alts = alts.reshape(-1)
         lengths = lengths.reshape(-1)
+
+        if wide:
+            nlong = jnp.int32(0)
+        else:
+            # long tail: quote beyond the 64-byte window → re-gather 256 B
+            is_long = (lengths < 0) & (starts < nbytes)
+            nlong = jnp.sum(is_long.astype(jnp.int32))
+            pos = jnp.cumsum(is_long.astype(jnp.int32)) - 1
+            tgt = jnp.where(is_long & (pos < cap_long), pos, cap_long)
+            lidx = jnp.full(cap_long, cap, jnp.int32).at[tgt].set(
+                jnp.arange(cap, dtype=jnp.int32), mode="drop")
+            lst = jnp.where(lidx < cap,
+                            jnp.take(ustarts, jnp.minimum(lidx, cap - 1)),
+                            jnp.int32(nbytes))
+            lwin = unaligned_words(words, lst, nw)
+            lln = first_byte_pos(lwin, QUOTE)
+            lln = jnp.where(lln >= _W_SHORT * 4, lln, jnp.int32(-1))
+            lids, lalt = _hash2(lwin, lln)
+            ids = ids.at[lidx].set(lids, mode="drop")
+            alts = alts.at[lidx].set(lalt, mode="drop")
+            lengths = lengths.at[lidx].set(lln, mode="drop")
+            nlong = jnp.where(nlong > cap_long, nlong, 0).astype(jnp.int32)
         docs = (jnp.searchsorted(file_starts, starts, side="right")
                 .astype(jnp.int32) - 1)
         valid = (starts < nbytes) & (lengths >= 0)
         npairs = jnp.sum(valid.astype(jnp.int32))
         order = jnp.argsort(~valid, stable=True)   # valid rows first
         pack = lambda x: jnp.take(x, order, axis=0)
-        return (pack(ids), pack(alts), pack(docs).astype(jnp.uint32),
-                pack(ustarts), pack(lengths), nhits, npairs)
+        pids, palts = pack(ids), pack(alts)
+        # collision check fused into the same dispatch (one id sort over
+        # cap rows — cheap next to the corpus passes, and it saves a
+        # round trip per run); multi-batch runs re-check globally
+        ncoll = _count_collisions(pids, palts, jnp.arange(cap) < npairs)
+        return (pids, palts, pack(docs).astype(jnp.uint32),
+                pack(ustarts), pack(lengths), nhits, npairs, ncoll, nlong)
 
     return run
+
+
+def _count_collisions(ids, alts, valid):
+    """Traceable: #ids carrying two different alt-ids among valid rows —
+    a real 64-bit intern collision (shared by the fused extract and the
+    multi-batch global check)."""
+    order = jnp.lexsort((alts, jnp.where(valid, ids, jnp.uint64(0)),
+                         ~valid))
+    a = jnp.take(ids, order)
+    b = jnp.take(alts, order)
+    v = jnp.take(valid, order)
+    return jnp.sum(((a[1:] == a[:-1]) & (b[1:] != b[:-1])
+                    & v[1:] & v[:-1]).astype(jnp.int32))
 
 
 def _assemble_parts(parts):
@@ -161,14 +228,8 @@ def _assemble_parts(parts):
 def _collision_check_fn():
     @jax.jit
     def run(ids, alts, npairs):
-        valid = jnp.arange(ids.shape[0]) < npairs
-        order = jnp.lexsort((alts, jnp.where(valid, ids, jnp.uint64(0)),
-                             ~valid))
-        a = jnp.take(ids, order)
-        b = jnp.take(alts, order)
-        v = jnp.take(valid, order)
-        bad = (a[1:] == a[:-1]) & (b[1:] != b[:-1]) & v[1:] & v[:-1]
-        return jnp.sum(bad.astype(jnp.int32))
+        return _count_collisions(ids, alts,
+                                 jnp.arange(ids.shape[0]) < npairs)
 
     return run
 
@@ -295,16 +356,27 @@ class InvertedIndex:
                 fstarts_d = jax.device_put(jnp.asarray(fstarts))
                 jax.block_until_ready(words)
 
-            cap = max(8, 1 << (max(1, len(corpus) // 512) - 1).bit_length())
+            # ~1 href/KB is the PUMA-style density; an overflow retries
+            # with the exact power-of-two capacity
+            cap = max(8, 1 << (max(1, len(corpus) // 1024) - 1).bit_length())
+            wide = False
             with self.timer.stage("map_device"):
                 while True:
-                    fn = _extract_fn(cap, self.use_pallas, self.interpret)
-                    ids, alts, docs, ustarts, lengths, nhits, npairs = fn(
-                        words, fstarts_d)
-                    nhits, npairs = map(int, jax.device_get((nhits, npairs)))
-                    if nhits <= cap:
+                    fn = (_extract_wide_fn if wide else _extract_fn)(
+                        cap, self.use_pallas, self.interpret)
+                    (ids, alts, docs, ustarts, lengths, nhits, npairs,
+                     ncoll, nlong) = fn(words, fstarts_d)
+                    nhits, npairs, ncoll, nlong = map(
+                        int, jax.device_get((nhits, npairs, ncoll, nlong)))
+                    if nhits > cap:
+                        cap = max(8, 1 << (nhits - 1).bit_length())  # retry
+                    elif nlong:
+                        wide = True   # long-URL-dense corpus: full windows
+                    else:
                         break
-                    cap = max(8, 1 << (nhits - 1).bit_length())  # retry
+                if ncoll:
+                    raise ValueError(
+                        f"{ncoll} 64-bit URL intern collision(s) detected")
                 if doc_base:
                     docs = docs + np.uint32(doc_base)
             parts.append((ids, alts, docs, npairs))
@@ -315,23 +387,27 @@ class InvertedIndex:
         if not parts:
             return
         with self.timer.stage("map_device"):
+            multi = len(parts) > 1
             ids, alts, docs, npairs = _assemble_parts(parts)
             if mesh1 is not None:
                 # zero-copy into the sharded KV: the packed device columns
                 # ARE the shard (P=1; capacity is a power of two >= 8);
-                # aggregate/convert/reduce stay on device
+                # aggregate/convert/reduce stay on device.  Per-batch
+                # collisions were checked inside extract; a multi-batch
+                # merge needs the global cross-batch check
                 from ..parallel.sharded import ShardedKV
                 kv.add_frame(ShardedKV(mesh1, ids, docs,
                                        np.array([npairs], np.int32)))
-                ncoll = int(_collision_check_fn()(
-                    ids, alts, jnp.int32(npairs)))
+                ncoll = (int(_collision_check_fn()(
+                    ids, alts, jnp.int32(npairs))) if multi else 0)
             else:
                 ids_h = np.asarray(ids[:npairs])
                 alts_h = np.asarray(alts[:npairs])
                 kv.add_batch(ids_h, np.asarray(docs[:npairs]))
                 order = np.lexsort((alts_h, ids_h))
                 a, b = ids_h[order], alts_h[order]
-                ncoll = int(((a[1:] == a[:-1]) & (b[1:] != b[:-1])).sum())
+                ncoll = (int(((a[1:] == a[:-1])
+                              & (b[1:] != b[:-1])).sum()) if multi else 0)
             if ncoll:
                 raise ValueError(
                     f"{ncoll} 64-bit URL intern collision(s) detected "

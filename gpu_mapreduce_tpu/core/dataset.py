@@ -411,12 +411,14 @@ def _coerce_rows(rows: list) -> Column:
     from .column import ObjectColumn
     first = rows[0]
     if isinstance(first, (bytes, str, bytearray)):
-        try:
+        if all(isinstance(r, (bytes, str, bytearray, memoryview))
+               for r in rows):
             return BytesColumn([r if isinstance(r, bytes) else
                                 (r.encode() if isinstance(r, str)
                                  else bytes(r)) for r in rows])
-        except (AttributeError, TypeError):
-            return ObjectColumn(rows)
+        # mixed with non-string rows (bytes(int) would silently build a
+        # NUL run): arbitrary objects, pickle tier
+        return ObjectColumn(rows)
     if first is None:
         return DenseColumn(np.zeros(len(rows), dtype=np.uint8))
     try:

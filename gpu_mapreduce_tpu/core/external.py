@@ -147,8 +147,12 @@ def _save_col(col: Column, path: str):
     if _col_kind(col) == "dense":
         np.save(path, np.asarray(col.to_host().data))
     else:
-        np.save(path, np.asarray(list(col.data), dtype=object),
-                allow_pickle=True)
+        # element-wise build: np.asarray(list, dtype=object) would turn
+        # uniform-length tuple rows into a 2-D array and corrupt keys
+        arr = np.empty(len(col), dtype=object)
+        for i, x in enumerate(col.data):
+            arr[i] = x
+        np.save(path, arr, allow_pickle=True)
 
 
 def _write_run(fr: KVFrame, settings, counters, seq: int) -> _Run:

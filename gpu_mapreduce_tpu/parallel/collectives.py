@@ -18,7 +18,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.frame import KVFrame
-from .mesh import AXIS, row_sharding
+from .mesh import mesh_axes, row_sharding, row_spec
 from .sharded import ShardedKV, shard_frame
 from .shuffle import exchange, _replace_kv_frames
 
@@ -37,20 +37,22 @@ def gather_kv(backend, mr, nprocs: int):
     if skv is None:
         return  # host-resident data is already "gathered"
     n = min(nprocs, backend.nprocs)
-    out = exchange(skv, ("fixed_mod", n), transport=mr.settings.all2all,
-                   counters=mr.counters)
+    out = exchange(skv, ("fixed_mod", n, backend.mesh),
+                   transport=mr.settings.all2all, counters=mr.counters)
     _replace_kv_frames(mr.kv, out)
 
 
 @functools.lru_cache(maxsize=None)
 def _broadcast_jit(mesh, root: int):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
+    axes = mesh_axes(mesh)
+    ax = axes[0] if len(axes) == 1 else axes
 
     @jax.jit
     def run(key, value):
         def body(k, v):
-            allk = lax.all_gather(k, AXIS)   # [P, cap, ...]
-            allv = lax.all_gather(v, AXIS)
+            allk = lax.all_gather(k, ax)     # [P, cap, ...]
+            allv = lax.all_gather(v, ax)
             return allk[root], allv[root]
         return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec),
                              out_specs=(spec, spec))(key, value)

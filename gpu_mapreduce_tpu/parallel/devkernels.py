@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .group import _local_segment_ids
-from .mesh import AXIS, row_sharding
+from .mesh import row_sharding, row_spec
 from .sharded import ShardedKMV, ShardedKV
 
 U64MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -57,7 +57,7 @@ def _pack(ok, ov, valid):
 
 @functools.lru_cache(maxsize=None)
 def _skv_map_jit(mesh, fn, static, nextra):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(key, value, count, *extra):
@@ -85,7 +85,7 @@ def skv_map(skv: ShardedKV, fn, static=(), extra=()) -> ShardedKV:
 
 @functools.lru_cache(maxsize=None)
 def _skmv_map_jit(mesh, fn, static, nextra):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(ukey, nval, voff, values, gcount, vcount, *extra):
@@ -117,7 +117,7 @@ def skmv_map(kmv: ShardedKMV, fn, static=(), extra=()) -> ShardedKV:
 
 @functools.lru_cache(maxsize=None)
 def _concat_jit(mesh):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(k1, v1, c1, k2, v2, c2):
@@ -167,7 +167,8 @@ def clone_sharded(skv: ShardedKV) -> ShardedKMV:
     return ShardedKMV(skv.mesh, skv.key,
                       jax.device_put(nv.reshape(-1), sharding),
                       jax.device_put(vo.reshape(-1), sharding),
-                      skv.value, skv.counts.copy(), skv.counts.copy())
+                      skv.value, skv.counts.copy(), skv.counts.copy(),
+                      key_decode=skv.key_decode)
 
 
 # ---------------------------------------------------------------------------

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from .mesh import AXIS, mesh_axis_size, row_sharding
+from .mesh import mesh_axis_size, row_sharding, row_spec
 from .sharded import ShardedKMV, ShardedKV, round_cap
 
 
@@ -44,7 +44,7 @@ def _boundary(skey, valid):
 
 @functools.lru_cache(maxsize=None)
 def _convert_phase1_jit(mesh):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def phase1(key, value, count):
@@ -61,7 +61,7 @@ def _convert_phase1_jit(mesh):
 
 @functools.lru_cache(maxsize=None)
 def _convert_phase2_jit(mesh, gcap: int):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def phase2(skey, mask):
@@ -151,7 +151,7 @@ def _reduce_cached(mesh, gcap, op, values_transform):
 
 
 def _reduce_build(mesh, gcap: int, op: str, values_transform):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(ukey, nval, voff, values, vcount):
@@ -216,7 +216,7 @@ def _huge(dtype):
 
 @functools.lru_cache(maxsize=None)
 def _first_jit(mesh):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(ukey, voff, values):
@@ -238,7 +238,7 @@ def first_sharded(kmv: ShardedKMV) -> ShardedKV:
 
 @functools.lru_cache(maxsize=None)
 def _sortmv_jit(mesh, descending: bool):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(voff, nval, values, vcount):
@@ -283,7 +283,7 @@ def _desc_key(v):
 
 @functools.lru_cache(maxsize=None)
 def _sort_jit(mesh, by: str, descending: bool):
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def run(key, value, count):

@@ -1,16 +1,26 @@
 """Mesh construction — the framework's MPI_COMM_WORLD.
 
-One flat axis ``"p"`` of "procs" (chips).  The reference's rank/size
-(``MPI_Comm_rank``/``MPI_Comm_size``) become ``lax.axis_index("p")`` and the
-axis size; multi-slice TPU systems can later map ``p`` to (slice, chip) so
-collectives ride ICI within a slice and DCN across (SURVEY.md §5)."""
+Flat form: one axis ``"p"`` of "procs" (chips); the reference's rank/size
+(``MPI_Comm_rank``/``MPI_Comm_size``) become ``lax.axis_index("p")`` and
+the axis size.
+
+Multi-slice form (``make_mesh2``): the proc axis factors into
+``("s", "c")`` — slice × chip — so datasets still shard by flat proc id
+(row i*C+c lives on slice i, chip c) but the shuffle can route
+hierarchically: ICI all-to-all within a slice first (grouping rows by
+destination chip), then ONE DCN all-to-all between same-chip-index peers
+across slices (shuffle._exchange_blocks).  That is the TPU analogue of
+the reference's single-level MPI world (SURVEY.md §5 'multi-slice'
+note; their NCCL/MPI stacks do the same hierarchical aggregation
+internally)."""
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 AXIS = "p"
@@ -25,13 +35,48 @@ def make_mesh(ndev: Optional[int] = None, devices: Optional[Sequence] = None
     return Mesh(np.asarray(devices), (AXIS,))
 
 
+def make_mesh2(nslice: int, nchip: Optional[int] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Multi-slice mesh: devices [nslice, nchip] over axes ("s", "c")."""
+    if devices is None:
+        devices = jax.devices()
+    if nchip is None:
+        nchip = len(devices) // nslice
+    devices = np.asarray(devices[:nslice * nchip]).reshape(nslice, nchip)
+    return Mesh(devices, ("s", "c"))
+
+
+def mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
 def mesh_axis_size(mesh: Mesh) -> int:
-    return int(mesh.shape[AXIS])
+    """Total proc count (product over all mesh axes)."""
+    n = 1
+    for a in mesh.axis_names:
+        n *= int(mesh.shape[a])
+    return n
+
+
+def row_spec(mesh: Mesh) -> PartitionSpec:
+    """PartitionSpec sharding dim 0 over ALL mesh axes (flat proc id =
+    row-major (slice, chip) index)."""
+    axes = mesh_axes(mesh)
+    return PartitionSpec(axes[0] if len(axes) == 1 else axes)
 
 
 def row_sharding(mesh: Mesh) -> NamedSharding:
     """Rows split over procs (axis 0 of every dataset array)."""
-    return NamedSharding(mesh, PartitionSpec(AXIS))
+    return NamedSharding(mesh, row_spec(mesh))
+
+
+def flat_axis_index(mesh: Mesh):
+    """Inside shard_map: this shard's flat proc id (row-major over axes)."""
+    axes = mesh_axes(mesh)
+    idx = lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * int(mesh.shape[a]) + lax.axis_index(a)
+    return idx
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -37,7 +37,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.frame import KVFrame
 from ..ops.hash import hash_words32
-from .mesh import AXIS, mesh_axis_size, row_sharding
+from .mesh import (AXIS, flat_axis_index, mesh_axes, mesh_axis_size,
+                   row_sharding, row_spec)
 from .sharded import ShardedKV, round_cap, shard_frame
 
 # ---------------------------------------------------------------------------
@@ -104,7 +105,7 @@ def _build_send(nprocs: int, B: int, rows, counts_local, round_idx: int = 0):
     return send.at[d, q].set(rows, mode="drop")
 
 
-def _ring_exchange(send):
+def _ring_exchange(send, mesh):
     """Systolic shift-by-one ring: recv[j] = what shard j holds for me.
 
     The reference's second transport is a hand-rolled Irecv/Send ring
@@ -115,15 +116,16 @@ def _ring_exchange(send):
     (ppermute's permutation must be trace-static, so a varying shift can't
     live in the loop): after s shifts my buffer is shard (me-s)'s original
     send array, and its row [me] is that shard's block for me."""
+    axes = mesh_axes(mesh)
     nprocs = send.shape[0]
-    me = lax.axis_index(AXIS)
+    me = flat_axis_index(mesh)
     perm = [(i, (i + 1) % nprocs) for i in range(nprocs)]
     recv = jnp.zeros_like(send)
     recv = recv.at[me].set(send[me])  # self-copy overlap (irregular.cpp:311)
 
     def body(s, carry):
         buf, recv = carry
-        buf = lax.ppermute(buf, AXIS, perm)
+        buf = lax.ppermute(buf, axes if len(axes) > 1 else axes[0], perm)
         recv = recv.at[(me - s) % nprocs].set(buf[me])
         return buf, recv
 
@@ -131,18 +133,39 @@ def _ring_exchange(send):
     return recv
 
 
-def _exchange_counts(counts_local, transport: int):
-    """Exchange per-dest counts: counts_from[j] = rows shard j sends me."""
-    if transport == 1:
-        return lax.all_to_all(counts_local[:, None], AXIS, 0, 0)[:, 0]
-    return _ring_exchange(counts_local[:, None])[:, 0]
+def _a2a_hier(send, mesh):
+    """Hierarchical all-to-all for a (slice, chip) mesh: rows for
+    (s', c') first move to the LOCAL chip c' over ICI (axis "c"), then
+    one DCN all-to-all between same-chip-index peers (axis "s") delivers
+    them — each cross-slice row crosses DCN exactly once, pre-aggregated
+    per (c', s') pair.  Output matches the flat all_to_all: recv[p] =
+    block from flat proc p."""
+    axes = mesh_axes(mesh)
+    S = int(mesh.shape[axes[0]])
+    C = int(mesh.shape[axes[1]])
+    x = send.reshape((S, C) + send.shape[1:])   # [dest_slice, dest_chip,...]
+    x = lax.all_to_all(x, axes[1], 1, 1)        # ICI: [dest_slice, src_c,...]
+    x = lax.all_to_all(x, axes[0], 0, 0)        # DCN: [src_s, src_c, ...]
+    return x.reshape(send.shape)
 
 
-def _exchange_blocks(send, transport: int):
+def _exchange_counts(counts_local, transport: int, mesh):
+    """Exchange per-dest counts: counts_from[j] = rows shard j sends me.
+    Multi-slice meshes always take the hierarchical route (a flat ring
+    would cross DCN on most hops — the pattern the hierarchy avoids)."""
+    if transport == 1 or len(mesh_axes(mesh)) == 2:
+        return _exchange_blocks(counts_local[:, None], transport, mesh)[:, 0]
+    return _ring_exchange(counts_local[:, None], mesh)[:, 0]
+
+
+def _exchange_blocks(send, transport: int, mesh):
     """[P,B,...] send blocks → [P,B,...] recv blocks."""
+    axes = mesh_axes(mesh)
+    if len(axes) == 2:
+        return _a2a_hier(send, mesh)            # ICI-then-DCN (module doc)
     if transport == 1:
-        return lax.all_to_all(send, AXIS, 0, 0)
-    return _ring_exchange(send)
+        return lax.all_to_all(send, axes[0], 0, 0)
+    return _ring_exchange(send, mesh)
 
 
 def _compact(recv, counts_from, cap_out: int):
@@ -170,9 +193,10 @@ def _dest_fn(dest, nprocs: int) -> Callable:
         return lambda keys: fn(keys) % nprocs
     if kind == "fixed_mod":
         n = dest[1]
+        mesh = dest[2]
 
         def fixed(keys):
-            me = lax.axis_index(AXIS)
+            me = flat_axis_index(mesh)
             d = (me % n).astype(jnp.int32)
             return jnp.full(keys.shape[0], d, jnp.int32)
         return fixed
@@ -196,7 +220,7 @@ def _phase1_cached(mesh, dest):
 def _phase1_build(mesh, dest):
     nprocs = mesh_axis_size(mesh)
     dest_of = _dest_fn(dest, nprocs)
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def phase1(key, value, count):
@@ -218,12 +242,12 @@ def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
     Received rows scatter directly to their final packed position
     (base[src] + round*B + slot), so no per-round compaction pass."""
     nprocs = mesh_axis_size(mesh)
-    spec = P(AXIS)
+    spec = row_spec(mesh)
 
     @jax.jit
     def phase2(skey, svalue, counts_local):
         def body(k, v, cl):
-            counts_from = _exchange_counts(cl, transport)
+            counts_from = _exchange_counts(cl, transport, mesh)
             cum = jnp.cumsum(counts_from)
             base = jnp.concatenate(
                 [jnp.zeros(1, jnp.int32), cum[:-1].astype(jnp.int32)])
@@ -232,9 +256,9 @@ def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
             slot = jnp.arange(B, dtype=jnp.int32)
             for r in range(nrounds):
                 recv_k = _exchange_blocks(
-                    _build_send(nprocs, B, k, cl, r), transport)
+                    _build_send(nprocs, B, k, cl, r), transport, mesh)
                 recv_v = _exchange_blocks(
-                    _build_send(nprocs, B, v, cl, r), transport)
+                    _build_send(nprocs, B, v, cl, r), transport, mesh)
                 # position of recv[j, q]: base[j] + r*B + q; invalid slots
                 # (past counts_from[j]) push out of range and drop
                 q_global = r * B + slot[None, :]
@@ -312,7 +336,7 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
         return
     frame = kv.one_frame()
     table = None
-    if isinstance(frame, KVFrame):
+    if isinstance(frame, KVFrame) and _values_shardable(frame):
         frame, table = _intern_frame(frame)
     if mesh_axis_size(backend.mesh) == 1:
         # reference early-out for nprocs==1 (src/mapreduce.cpp:403-406):
@@ -345,6 +369,14 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     _replace_kv_frames(kv, out)
 
 
+def _values_shardable(frame: KVFrame) -> bool:
+    """Whether the VALUE column can live on device — checked before the
+    key intern pass so byte-valued frames don't pay a full key hashing
+    round just to stay host-resident anyway."""
+    from ..core.column import DenseColumn
+    return isinstance(frame.value, DenseColumn)
+
+
 def _key_bytes_rows(col) -> list:
     """Raw per-row key bytes — what the reference's user hash receives."""
     from ..core.column import BytesColumn, ObjectColumn
@@ -364,13 +396,13 @@ def _aggregate_host_hash(backend, mr, hash_fn):
         frame = frame.to_host()
     if len(frame) == 0:
         return
-    dest = (np.asarray(hash_fn(_key_bytes_rows(frame.key)))
-            .astype(np.int64) % P).astype(np.int32)
-    frame, table = _intern_frame(frame)
-    if not frame.is_dense():
+    if not _values_shardable(frame):
         mr.error.warning(
             "aggregate(host hash): byte-string VALUES stay host-resident")
         return
+    dest = (np.asarray(hash_fn(_key_bytes_rows(frame.key)))
+            .astype(np.int64) % P).astype(np.int32)
+    frame, table = _intern_frame(frame)
     order = np.argsort(dest, kind="stable")
     counts = np.bincount(dest, minlength=P).astype(np.int32)
     from .sharded import shard_frame_with_counts

@@ -1,0 +1,114 @@
+"""Scale soak: RMAT graph workloads on the real chip, recording numbers
+into BASELINE.json["published"] (VERDICT r1 #10 — the regression guard for
+the device-tier graph iteration and the out-of-core machinery).
+
+Runs on whatever jax.default_backend() provides (the driver's TPU, or CPU
+with the fake-cluster flags).  Workloads, all through the public
+framework surface:
+
+* rmat generation (models/rmat.generate_unique — the oink rmat cull loop)
+* degree: edges → collate → count on a 1-chip mesh (device tier)
+* cc_find: the full OINK command on a 1-chip mesh (device-resident loop)
+* pagerank: models/pagerank sharded convergence loop — edges/sec/iter,
+  the BASELINE.json north-star metric (the reference's pagerank is a
+  stub, oink/pagerank.cpp:53-55, so this races no reference number)
+
+Usage:  python soak.py            (scale from SOAK_SCALE, default 18)
+Writes: BASELINE.json published.{rmat_edges_per_sec, degree_edges_per_sec,
+        cc_find_edges_per_sec_per_iter, pagerank_edges_per_sec_per_iter}
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from gpu_mapreduce_tpu.models.rmat import generate_unique
+    from gpu_mapreduce_tpu.models.pagerank import pagerank_sharded
+    from gpu_mapreduce_tpu.oink import ObjectManager, run_command
+    from gpu_mapreduce_tpu.oink.kernels import count, edge_to_vertices
+    from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    scale = int(os.environ.get("SOAK_SCALE", "18"))
+    nnz = int(os.environ.get("SOAK_NNZ", "8"))
+    backend = jax.default_backend()
+    published = {}
+
+    # -- rmat ----------------------------------------------------------
+    t0 = time.perf_counter()
+    edges, iters = generate_unique(seed=11, nlevels=scale, nnonzero=nnz,
+                                   abcd=(0.57, 0.19, 0.19, 0.05), frac=0.1)
+    dt = time.perf_counter() - t0
+    nedges = len(edges)
+    published["rmat_edges_per_sec"] = round(nedges / dt, 1)
+    print(f"rmat scale={scale} nnz={nnz}: {nedges} edges in {iters} "
+          f"rounds, {dt:.2f}s -> {nedges / dt:,.0f} edges/s")
+
+    mesh = make_mesh(1)
+
+    # -- degree (edges → collate → count), device tier -----------------
+    mr = MapReduce(mesh)
+    e64 = edges.astype(np.uint64)
+    mr.map(1, lambda i, kv, p: kv.add_batch(
+        e64, np.zeros(len(e64), np.uint8)))
+    t0 = time.perf_counter()
+    mr.map_mr(mr, edge_to_vertices, batch=True)
+    mr.collate()
+    ndeg = mr.reduce(count, batch=True)
+    dt = time.perf_counter() - t0
+    published["degree_edges_per_sec"] = round(nedges / dt, 1)
+    print(f"degree: {ndeg} vertices, {dt:.2f}s -> "
+          f"{nedges / dt:,.0f} edges/s")
+
+    # -- cc_find (full OINK command, device-resident loop) -------------
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "edges.txt")
+        sub = edges[: min(len(edges), 1 << (scale - 1))]
+        sub = sub[sub[:, 0] != sub[:, 1]]
+        np.savetxt(path, sub, fmt="%d")
+        obj = ObjectManager(comm=mesh)
+        t0 = time.perf_counter()
+        cmd = run_command("cc_find", ["0"], obj=obj, inputs=[path],
+                          screen=False)
+        dt = time.perf_counter() - t0
+        per_iter = dt / max(1, cmd.niterate)
+        published["cc_find_edges_per_sec_per_iter"] = round(
+            len(sub) / per_iter, 1)
+        print(f"cc_find: {cmd.ncc} components, {cmd.niterate} iters, "
+              f"{dt:.2f}s -> {len(sub) / per_iter:,.0f} edges/s/iter")
+
+    # -- pagerank (north-star metric) ----------------------------------
+    n = 1 << scale
+    src = edges[:, 0].astype(np.int32)
+    dst = edges[:, 1].astype(np.int32)
+    t0 = time.perf_counter()
+    ranks, niter = pagerank_sharded(mesh, src, dst, n, tol=1e-6, maxiter=20)
+    dt = time.perf_counter() - t0
+    per_iter = dt / max(1, niter)
+    published["pagerank_edges_per_sec_per_iter"] = round(
+        nedges / per_iter, 1)
+    print(f"pagerank: {niter} iters, {dt:.2f}s -> "
+          f"{nedges / per_iter:,.0f} edges/s/iter "
+          f"(sum={float(np.asarray(ranks).sum()):.4f})")
+
+    published["backend"] = backend
+    published["rmat_scale"] = scale
+    published["nedges"] = nedges
+
+    with open("BASELINE.json") as f:
+        base = json.load(f)
+    base["published"] = published
+    with open("BASELINE.json", "w") as f:
+        json.dump(base, f, indent=2)
+    print("BASELINE.json published:", json.dumps(published))
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,35 @@ def test_pipeline_on_mesh(html_corpus):
     assert n1 == n2
 
 
+def test_long_url_second_tier(tmp_path):
+    """URLs longer than the 64-byte first-tier window take the 256-byte
+    re-gather path; ones beyond MAX_URL still drop."""
+    long_url = b"http://example.org/" + b"x" * 150          # tier 2
+    giant = b"http://example.org/" + b"y" * 400             # > MAX_URL: drop
+    short = b"http://e/"
+    f = tmp_path / "long.html"
+    f.write_bytes(b'<a href="%s">a</a><a href="%s">b</a><a href="%s">c</a>'
+                  % (short, long_url, giant))
+    ii = InvertedIndex()
+    nhits, nurl = ii.run([str(f)])
+    assert (nhits, nurl) == (2, 2)
+    assert sorted(ii.urls.values()) == sorted([short, long_url])
+
+
+def test_long_url_dense_corpus_wide_fallback(tmp_path):
+    """More long URLs than the long-tail capacity → the wide (full-window)
+    fallback must engage and still match the oracle."""
+    urls = [b"http://example.org/" + bytes([97 + i % 26]) * 120
+            for i in range(40)]
+    f = tmp_path / "dense.html"
+    f.write_bytes(b"".join(b'<a href="%s">x</a>' % u for u in urls))
+    ii = InvertedIndex()
+    nhits, nurl = ii.run([str(f)])
+    assert nhits == len(urls)
+    assert nurl == len(set(urls))
+    assert sorted(set(ii.urls.values())) == sorted(set(urls))
+
+
 def test_multi_batch_corpus(html_corpus, monkeypatch):
     """Force the per-corpus byte cap below one file so every file becomes
     its own batch — counts and url dict must match the single-batch run."""

@@ -1,0 +1,77 @@
+"""Weak-scaling harness — the analogue of the reference's cuda_scale/
+variant (fixed ~20×128 MB files per process, cuda_scale/InvertedIndex.cu:276)
+and its Fig. 4 stage-time study (chapter_final.pdf §3.4: map/sort/reduce
+stay flat as procs grow; network I/O grows).
+
+Holds the per-shard corpus CONSTANT while the mesh grows (P=1,2,4,8 on
+the CPU fake cluster, or whatever the current backend offers) and runs
+the full wordfreq pipeline — map, aggregate (the network stage), convert,
+reduce — printing per-stage wall time per P.  A flat map/convert row and
+a growing aggregate row reproduces the reference's finding.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+       python weakscale.py [mb_per_proc]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def make_files(tmpdir: str, nfiles: int, mb_each: float):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    vocab = [b"w%05d" % i for i in range(20000)]
+    paths = []
+    for i in range(nfiles):
+        words = rng.choice(len(vocab), int(mb_each * (1 << 20) / 7))
+        data = b" ".join(vocab[w] for w in words)
+        p = os.path.join(tmpdir, f"part-{i:05d}.txt")
+        with open(p, "wb") as f:
+            f.write(data)
+        paths.append(p)
+    return paths
+
+
+def main():
+    from gpu_mapreduce_tpu.utils.platform import pin_platform
+    pin_platform()
+    import jax
+    from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+    from gpu_mapreduce_tpu.core.runtime import Timer
+    from gpu_mapreduce_tpu.oink.kernels import count, read_words
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    mb_per_proc = float(sys.argv[1]) if len(sys.argv) > 1 else 2.0
+    ndev = len(jax.devices())
+    sizes = [p for p in (1, 2, 4, 8, 16) if p <= ndev]
+    rows = []
+    with tempfile.TemporaryDirectory() as tmpdir:
+        files = make_files(tmpdir, max(sizes), mb_per_proc)
+        for P in sizes:
+            mr = MapReduce(make_mesh(P))
+            stages = {}
+            t = Timer()
+            mr.map_files(files[:P], read_words)
+            stages["map"] = t.elapsed()
+            t = Timer()
+            mr.aggregate()          # the "network I/O" stage
+            stages["aggregate"] = t.elapsed()
+            t = Timer()
+            mr.convert()
+            stages["convert"] = t.elapsed()
+            t = Timer()
+            n = mr.reduce(count, batch=True)
+            stages["reduce"] = t.elapsed()
+            rows.append({"nprocs": P, "nunique": int(n),
+                         **{k: round(v, 3) for k, v in stages.items()}})
+            print(json.dumps(rows[-1]))
+    print(json.dumps({"weak_scaling": rows,
+                      "mb_per_proc": mb_per_proc,
+                      "backend": jax.default_backend()}))
+
+
+if __name__ == "__main__":
+    main()
