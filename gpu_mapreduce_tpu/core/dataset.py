@@ -23,6 +23,7 @@ analogue of the Allreduce in ``KeyValue::complete`` (src/keyvalue.cpp:216-255).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -32,11 +33,15 @@ from .frame import KMVFrame, KVFrame
 from .runtime import Counters, Error, Settings
 
 _INSTANCE_COUNTER = [0]
+_INSTANCE_LOCK = threading.Lock()
 
 
 def _next_file_id() -> int:
-    _INSTANCE_COUNTER[0] += 1
-    return _INSTANCE_COUNTER[0]
+    # atomic: concurrent -partition worlds (oink/universe.py threads)
+    # must never share a spill-file id
+    with _INSTANCE_LOCK:
+        _INSTANCE_COUNTER[0] += 1
+        return _INSTANCE_COUNTER[0]
 
 
 class _Spilled:

@@ -40,7 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import AXIS
+from ..parallel.mesh import mesh_axes, mesh_axis_size, row_spec
 
 
 def out_degrees(src: jax.Array, n: int, valid=None) -> jax.Array:
@@ -104,13 +104,14 @@ def pagerank(src: jax.Array, dst: jax.Array, n: int, tol: float = 1e-6,
 # sharded (multi-chip) path
 # ---------------------------------------------------------------------------
 
-def _sharded_step(ranks, src, dst, inv_outdeg, valid, damping):
+def _sharded_step(ranks, src, dst, inv_outdeg, valid, damping, axes):
     """shard_map body: local segment-sum of the shard's edges, then one
-    psum over ICI merges per-shard inflows (replicated ranks in, replicated
-    ranks out)."""
+    psum (over every mesh axis — ICI within a slice, DCN across for a
+    multi-slice mesh) merges per-shard inflows (replicated ranks in,
+    replicated ranks out)."""
     n = ranks.shape[0]
     contrib = jnp.where(valid, ranks[src] * inv_outdeg[src], 0.0)
-    inflow = lax.psum(jax.ops.segment_sum(contrib, dst, num_segments=n), AXIS)
+    inflow = lax.psum(jax.ops.segment_sum(contrib, dst, num_segments=n), axes)
     return ((1.0 - damping) / n +
             damping * (inflow + _dangling_mass(ranks, inv_outdeg)))
 
@@ -133,20 +134,22 @@ def _sharded_run_fn(mesh: Mesh, n: int, tol: float, maxiter: int,
                     damping: float):
     """Compile-once (per mesh/shape/params) sharded convergence loop."""
     rep = NamedSharding(mesh, P())
+    axes = mesh_axes(mesh)       # works for flat ("p",) and ("s","c")
+    rspec = row_spec(mesh)
 
     @functools.partial(jax.jit, out_shardings=(rep, rep))
     def run(src_d, dst_d, valid_d):
         deg = jax.shard_map(
-            lambda s, v: lax.psum(out_degrees(s, n, valid=v), AXIS),
-            mesh=mesh, in_specs=(P(AXIS), P(AXIS)), out_specs=P())(
+            lambda s, v: lax.psum(out_degrees(s, n, valid=v), axes),
+            mesh=mesh, in_specs=(rspec, rspec), out_specs=P())(
                 src_d, valid_d)
         inv = inv_outdegrees(deg)
         r0 = jnp.full((n,), 1.0 / n, jnp.float32)
 
         step = jax.shard_map(
-            functools.partial(_sharded_step, damping=damping),
+            functools.partial(_sharded_step, damping=damping, axes=axes),
             mesh=mesh,
-            in_specs=(P(), P(AXIS), P(AXIS), P(), P(AXIS)),
+            in_specs=(P(), rspec, rspec, P(), rspec),
             out_specs=P())
 
         def cond(state):
@@ -168,11 +171,12 @@ def _sharded_run_fn(mesh: Mesh, n: int, tol: float, maxiter: int,
 def pagerank_sharded(mesh: Mesh, src: np.ndarray, dst: np.ndarray, n: int,
                      tol: float = 1e-6, maxiter: int = 100,
                      damping: float = 0.85) -> Tuple[np.ndarray, int]:
-    """Edge-parallel PageRank over a device mesh.  Edges are block-sharded
-    on axis ``p``; ranks replicated; one psum per iteration rides ICI."""
-    nprocs = int(mesh.shape[AXIS])
+    """Edge-parallel PageRank over a device mesh (flat or multi-slice).
+    Edges are block-sharded over all mesh axes; ranks replicated; one
+    psum per iteration rides ICI (+DCN across slices)."""
+    nprocs = mesh_axis_size(mesh)
     src_p, dst_p, valid_p = pad_edges_for_mesh(src, dst, nprocs)
-    edge_shard = NamedSharding(mesh, P(AXIS))
+    edge_shard = NamedSharding(mesh, row_spec(mesh))
     src_d = jax.device_put(src_p, edge_shard)
     dst_d = jax.device_put(dst_p, edge_shard)
     valid_d = jax.device_put(valid_p, edge_shard)

@@ -14,8 +14,9 @@ MPI_Bcasts them (``input.cpp:130-148``) — here the interpreter is host
 Python driving device-parallel MapReduce objects, so no line broadcast
 exists; command timing keeps the reference's semantics (elapsed seconds
 of the last command, exposed as the ``time`` EQUAL keyword) without the
-barriers.  ``-partition`` multi-world runs are not supported (see
-variables.py on WORLD/UNIVERSE).
+barriers.  ``-partition`` multi-world runs split the device mesh into
+per-world sub-meshes driven by concurrent interpreter threads — see
+``universe.py``.
 """
 
 from __future__ import annotations
@@ -39,9 +40,10 @@ class OinkScript:
     ``comm``: optional mesh (forwarded to every MR the script creates).
     ``screen``: None → stdout, False → silent, or a file-like."""
 
-    def __init__(self, comm=None, screen=None, logfile: Optional[str] = None):
+    def __init__(self, comm=None, screen=None, logfile: Optional[str] = None,
+                 world=None):
         self.obj = ObjectManager(comm=comm)
-        self.variables = Variables()
+        self.variables = Variables(world=world)
         self.dispatch = MRScriptDispatch(self.obj, self.variables)
         self.screen: Optional[TextIO]
         if screen is None:
@@ -510,13 +512,17 @@ def _split_args(line: str) -> List[str]:
 def main(argv: Optional[List[str]] = None) -> int:
     """oink-style driver: ``python -m gpu_mapreduce_tpu.oink.script
     [-in file] [-log file|none] [-screen file|none] [-echo style]
-    [-var name value...]`` (reference oink.cpp:45-125)."""
+    [-partition NxM ...] [-var name value...]``
+    (reference oink.cpp:45-125)."""
     argv = list(sys.argv[1:] if argv is None else argv)
     infile = None
     logname: Optional[str] = "log.oink"
+    lograw: Optional[str] = None      # the explicit -log value, if any
     screen: object = None
+    screenraw: Optional[str] = None
     echo = None
     varsets = []
+    partition: List[str] = []
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -524,15 +530,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             infile = argv[i + 1]
             i += 2
         elif a in ("-log", "-l"):
-            logname = None if argv[i + 1] == "none" else argv[i + 1]
+            lograw = argv[i + 1]
+            logname = None if lograw == "none" else lograw
             i += 2
         elif a in ("-screen", "-sc"):
-            screen = False if argv[i + 1] == "none" \
-                else open(argv[i + 1], "w")
+            screenraw = argv[i + 1]
             i += 2
         elif a in ("-echo", "-e"):
             echo = argv[i + 1]
             i += 2
+        elif a in ("-partition", "-p"):
+            i += 1
+            while i < len(argv) and not argv[i].startswith("-"):
+                partition.append(argv[i])
+                i += 1
+            if not partition:
+                raise SystemExit("Invalid command-line argument: "
+                                 "-partition needs world specs")
         elif a in ("-var", "-v"):
             name = argv[i + 1]
             vals = []
@@ -543,6 +557,35 @@ def main(argv: Optional[List[str]] = None) -> int:
             varsets.append((name, vals))
         else:
             raise SystemExit(f"Invalid command-line argument: {a}")
+    if partition:
+        # multi-world run (reference oink.cpp:99-100 requires -in)
+        if not infile:
+            raise SystemExit("Must use -in switch with multiple partitions")
+        from .universe import Universe, run_universe
+
+        # the reference gets its proc count from mpirun; ours comes from
+        # the visible device list — build a mesh exactly as large as the
+        # partition specs demand (worlds then split it)
+        probe = Universe(0)
+        for spec in partition:
+            probe.add_world(spec)
+        total = sum(probe.procs_per_world)
+        if total <= 1:
+            comm = None
+        else:
+            import jax
+
+            from ..parallel.mesh import make_mesh
+            if len(jax.devices()) < total:
+                raise SystemExit(
+                    f"Processor partitions are inconsistent: specs need "
+                    f"{total} procs, {len(jax.devices())} devices visible")
+            comm = make_mesh(total)
+        run_universe(infile, partition, comm=comm, logname=lograw,
+                     screenname=screenraw, echo=echo, varsets=varsets)
+        return 0
+    if screenraw is not None:
+        screen = False if screenraw == "none" else open(screenraw, "w")
     interp = OinkScript(screen=screen, logfile=logname)
     if echo:
         interp.cmd_echo([echo])

@@ -1,0 +1,178 @@
+"""Multi-world (``-partition``) runs — the OINK Universe.
+
+Reference: ``oink/universe.{h,cpp}`` (world bookkeeping: NxM / P specs,
+``add_world`` ``universe.cpp:55-88``, ``consistent`` ``:94-99``) and
+``oink/oink.cpp:46-57,138-236`` (the -partition switch, MPI_Comm_split
+into per-world communicators, per-world ``screen.N``/log files, the
+universe-level banner).
+
+TPU redesign.  The reference's "procs" are MPI ranks; ours are mesh
+devices under one controller.  ``-partition`` therefore splits the
+DEVICE LIST into consecutive sub-meshes (the MPI_Comm_split analog:
+world i owns devices [root_proc[i], root_proc[i]+procs_per_world[i])) and
+runs one interpreter per world in its OWN THREAD — worlds progress
+concurrently, each driving its sub-mesh, the way the reference's worlds
+are concurrent MPI jobs.  ULOOP work-sharing coordinates through a
+mutex-guarded shared counter instead of the reference's
+``tmp.oink.variable`` rename-lock file (variables.WorldContext).
+
+Per-world files follow the reference naming: default screen →
+``screen.N`` (oink.cpp:170-174), ``-screen base`` → ``base.N``;
+``-log base`` → ``base.N``.  Default log → ``log.oink.N`` (the reference
+writes ``log.lammps.N`` here, oink.cpp:188 — an upstream LAMMPS leftover
+we deliberately normalise).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from ..core.runtime import MRError
+from .variables import UloopCounter, WorldContext
+
+
+class Universe:
+    """World layout over ``nprocs`` procs (reference Universe class)."""
+
+    def __init__(self, nprocs: int):
+        self.nprocs = nprocs
+        self.procs_per_world: List[int] = []
+        self.root_proc: List[int] = []
+
+    @property
+    def nworlds(self) -> int:
+        return len(self.procs_per_world)
+
+    def add_world(self, spec: Optional[str]):
+        """None → 1 world, all procs; ``NxM`` → N worlds of M procs;
+        ``P`` → 1 world of P procs (universe.cpp:55-88)."""
+        if spec is None:
+            n, nper = 1, self.nprocs
+        elif "x" in spec:
+            a, b = spec.split("x", 1)
+            n, nper = int(a), int(b)
+        else:
+            n, nper = 1, int(spec)
+        for _ in range(n):
+            root = 0 if not self.root_proc else \
+                self.root_proc[-1] + self.procs_per_world[-1]
+            self.procs_per_world.append(nper)
+            self.root_proc.append(root)
+
+    def consistent(self) -> bool:
+        return sum(self.procs_per_world) == self.nprocs
+
+
+def _world_comm(comm, universe: Universe, iworld: int):
+    """Sub-mesh of world ``iworld`` (the MPI_Comm_split analog,
+    oink.cpp:165)."""
+    if comm is None:
+        return None
+    from ..parallel.mesh import make_mesh
+    lo = universe.root_proc[iworld]
+    hi = lo + universe.procs_per_world[iworld]
+    return make_mesh(devices=list(comm.devices.flat)[lo:hi])
+
+
+def _world_filename(base: Optional[str], default: str, iworld: int
+                    ) -> Optional[str]:
+    """Reference naming: ``none`` → no file; explicit base → base.N;
+    unset → default.N (oink.cpp:168-202)."""
+    if base == "none":
+        return None
+    return f"{base or default}.{iworld}"
+
+
+def run_universe(infile: str, partition_specs: Sequence[str], comm=None,
+                 logname: Optional[str] = None,
+                 screenname: Optional[str] = None,
+                 echo: Optional[str] = None,
+                 varsets: Sequence = (), uscreen=None) -> "Universe":
+    """Run ``infile`` once per world, concurrently.
+
+    ``comm``: the full mesh to split (None → 1 proc, serial worlds).
+    ``logname``/``screenname``: CLI -log/-screen values ("none" → off).
+    ``varsets``: [(name, [values...])] from -var switches.
+    ``uscreen``: universe-level stream (None → stdout)."""
+    import sys
+
+    from .script import OinkScript
+
+    if comm is None:
+        nprocs = 1
+    else:
+        from ..parallel.mesh import mesh_axis_size
+        nprocs = mesh_axis_size(comm)
+    universe = Universe(nprocs)
+    for spec in partition_specs:
+        universe.add_world(spec)
+    if not universe.procs_per_world:
+        universe.add_world(None)
+    if not universe.consistent():
+        raise MRError("Processor partitions are inconsistent")
+
+    if uscreen is None:
+        uscreen = sys.stdout
+    ulock = threading.Lock()
+
+    def uemit(text: str):
+        if uscreen is not False and uscreen is not None:
+            with ulock:
+                uscreen.write(text)
+                uscreen.flush()
+
+    uemit(f"Running on {universe.nworlds} partitions of processors\n")
+
+    counter = UloopCounter(universe.nworlds)
+
+    def on_advance(nextindex: int, iworld: int):
+        # the reference's universe-level progress line
+        # (variable.cpp:367-374; it prints nextindex+1)
+        uemit(f"Increment via next: value {nextindex + 1} on partition "
+              f"{iworld}\n")
+
+    errors: List[tuple] = []
+
+    def run_world(iworld: int):
+        # EVERYTHING is inside the try: a failed screen/log open or
+        # sub-mesh build must land in `errors`, not vanish into the
+        # thread's default excepthook while the universe reports success
+        screen: object = False
+        interp = None
+        try:
+            world = WorldContext(iworld, universe.nworlds, counter,
+                                 on_advance)
+            wcomm = _world_comm(comm, universe, iworld)
+            screenfile = _world_filename(screenname, "screen", iworld)
+            logfile = _world_filename(logname, "log.oink", iworld)
+            screen = open(screenfile, "w") if screenfile else False
+            interp = OinkScript(comm=wcomm, screen=screen, logfile=logfile,
+                                world=world)
+            interp._emit(f"Processor partition = {iworld}\n")
+            if echo:
+                interp.cmd_echo([echo])
+            for name, vals in varsets:
+                interp.variables.set([name, "index"] + list(vals))
+            interp.run_file(infile)
+        except BaseException as e:  # surfaced after join
+            errors.append((iworld, e))
+        finally:
+            if interp is not None:
+                interp.close()
+            if screen:
+                screen.close()
+
+    threads = [threading.Thread(target=run_world, args=(i,),
+                                name=f"oink-world-{i}")
+               for i in range(universe.nworlds)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        errors.sort(key=lambda t: t[0])
+        detail = "; ".join(f"world {i}: {e}" for i, e in errors)
+        raise MRError(f"{len(errors)} world(s) failed: {detail}") \
+            from errors[0][1]
+    return universe

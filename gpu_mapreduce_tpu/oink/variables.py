@@ -12,12 +12,16 @@ Redesigns vs the reference:
 * the evaluator is a recursive-descent parser over a token list instead
   of the reference's dual value/operator stack machine — same grammar,
   same precedence table (``variable.cpp:60-69``), no ``eval()``;
-* WORLD/UNIVERSE/ULOOP exist for script parity but run single-world:
-  WORLD picks its first value, UNIVERSE/ULOOP behave as INDEX/LOOP (the
-  reference splits MPI_COMM_WORLD into partitions and coordinates ULOOP
-  through a lock file, ``variable.cpp:186-240`` — a multi-job scheduling
-  device, not a data-parallel one; our mesh parallelism lives below the
-  MapReduce API instead).
+* WORLD/UNIVERSE/ULOOP are multi-world styles.  The reference splits
+  MPI_COMM_WORLD into partitions and coordinates ULOOP through a lock
+  file on shared disk (``variable.cpp:186-240``, ``next()``
+  ``variable.cpp:345-383``).  Here a :class:`WorldContext` carries the
+  world index/count and a lock-protected shared counter (worlds are
+  threads of one controller, so the lock file becomes a mutex — same
+  claim-the-next-index semantics).  Without a context the table runs
+  single-world (iworld 0, nworlds 1), which reproduces the reference's
+  serial behaviour exactly: UNIVERSE/ULOOP start at 0 and each ``next``
+  claims 1, 2, ... like LOOP.
 """
 
 from __future__ import annotations
@@ -25,11 +29,75 @@ from __future__ import annotations
 import math
 import random as _random
 import re
+import threading
 from typing import Callable, Dict, List, Optional
 
 from ..core.runtime import MRError
 
 _STYLES = ("index", "loop", "world", "universe", "uloop", "string", "equal")
+
+
+class WorldContext:
+    """This world's place in the universe (reference Universe fields
+    ``iworld``/``nworlds`` + the ``tmp.oink.variable`` lock file).
+
+    One instance per world; ``counter`` is SHARED between the worlds of
+    one universe (the runner passes the same object to all).  The
+    counter starts at ``nworlds`` — world i implicitly owns index i, the
+    first ``next`` anywhere claims ``nworlds``, exactly the number the
+    reference seeds its lock file with (``variable.cpp:215-219``)."""
+
+    def __init__(self, iworld: int = 0, nworlds: int = 1,
+                 counter: Optional["UloopCounter"] = None,
+                 on_advance: Optional[Callable[[int, int], None]] = None):
+        self.iworld = iworld
+        self.nworlds = nworlds
+        self.counter = counter if counter is not None \
+            else UloopCounter(nworlds)
+        self.on_advance = on_advance   # (nextindex, iworld) → universe log
+
+    def uloop_next(self) -> int:
+        nextindex = self.counter.claim()
+        if self.on_advance is not None:
+            self.on_advance(nextindex, self.iworld)
+        return nextindex
+
+    def uloop_seed(self, name: str, generation: int):
+        """(Re)seed the shared counter at variable definition so a
+        SECOND uloop loop later in the script starts fresh instead of
+        resuming the exhausted counter (the reference rewrites its lock
+        file with nworlds at every definition, variable.cpp:215-219).
+
+        Reseeding is once per (variable, definition-generation): the
+        FIRST world to define it wins, later worlds' definitions are
+        no-ops — unlike a naive proc-0 reset, a straggler world defining
+        the variable after others already claimed indices cannot rewind
+        the counter and hand an index out twice."""
+        self.counter.seed(name, generation, self.nworlds)
+
+
+class UloopCounter:
+    """The shared next-index source (the reference's lock file, made a
+    mutex: rename()-as-lock → threading.Lock, variable.cpp:350-366)."""
+
+    def __init__(self, start: int):
+        self._next = start
+        self._lock = threading.Lock()
+        self._gens: Dict[str, int] = {}   # var name → seeded generation
+
+    def claim(self) -> int:
+        with self._lock:
+            n = self._next
+            self._next += 1
+            return n
+
+    def seed(self, name: str, generation: int, start: int):
+        """Reset to ``start`` the first time (name, generation) is seen;
+        the same definition executed by the other worlds is a no-op."""
+        with self._lock:
+            if self._gens.get(name, 0) < generation:
+                self._gens[name] = generation
+                self._next = start
 
 
 class _Var:
@@ -50,10 +118,12 @@ class Variables:
     interpreter installs ``time`` (elapsed seconds of the last command,
     ``oink/input.cpp:458-464``) and ``nprocs``."""
 
-    def __init__(self):
+    def __init__(self, world: Optional[WorldContext] = None):
         self._vars: Dict[str, _Var] = {}
         self.specials: Dict[str, Callable[[], float]] = {}
         self._rng: Optional[_random.Random] = None
+        self.world = world if world is not None else WorldContext()
+        self._uni_gen: Dict[str, int] = {}  # this table's definition count
 
     # -- the `variable` command (reference Variable::set) ------------------
     def set(self, args: List[str]):
@@ -83,7 +153,18 @@ class Variables:
                 raise MRError("Illegal variable command")
             v = _Var(style, args[2:])
             if style == "world":
-                v.which = 0        # single world (see module docstring)
+                # one value per partition (variable.cpp:166-168)
+                if v.num != self.world.nworlds:
+                    raise MRError("World variable count doesn't match # "
+                                  "of partitions")
+                v.which = self.world.iworld
+            elif style == "universe":
+                if v.num < self.world.nworlds:
+                    raise MRError("Universe/uloop variable count < # of "
+                                  "partitions")
+                v.which = self.world.iworld
+                self._check_uni_lengths(v)
+                self._seed_uni(name)
         elif style in ("loop", "uloop"):
             rest = args[2:]
             pad = 0
@@ -91,16 +172,31 @@ class Variables:
                 rest = rest[:-1]
                 pad = 1
             if len(rest) == 1:
-                nfirst, nlast = 1, int(rest[0])
+                # ULOOP is 0-based in the reference (offset stays 0,
+                # variable.cpp:196-201 + retrieve :405-407); LOOP is
+                # 1-based (offset = nfirst = 1, :128-134)
+                nfirst, nlast = (0, int(rest[0]) - 1) \
+                    if style == "uloop" else (1, int(rest[0]))
             elif len(rest) == 2 and style == "loop":
                 nfirst, nlast = int(rest[0]), int(rest[1])
             else:
                 raise MRError("Illegal variable command")
-            if nfirst > nlast or nlast <= 0:
+            if nfirst > nlast or nlast < 0 or \
+                    (style == "loop" and nlast <= 0):
                 raise MRError("Illegal variable command")
+            # pad width: digits of N (for uloop the count, variable.cpp
+            # :203-206; for loop the last value, :135-141)
             v = _Var(style, [], offset=nfirst,
-                     pad=len(str(nlast)) if pad else 0)
+                     pad=len(str(nlast + 1 if style == "uloop" else nlast))
+                     if pad else 0)
             v.num = nlast - nfirst + 1
+            if style == "uloop":
+                if v.num < self.world.nworlds:
+                    raise MRError("Universe/uloop variable count < # of "
+                                  "partitions")
+                v.which = self.world.iworld
+                self._check_uni_lengths(v)
+                self._seed_uni(name)
         elif style == "string":
             if len(args) != 3:
                 raise MRError("Illegal variable command")
@@ -110,6 +206,22 @@ class Variables:
                 raise MRError("Illegal variable command")
             v = _Var(style, [args[2]])
         self._vars[name] = v
+
+    def _seed_uni(self, name: str):
+        """Definition-time counter seed: this table's Nth definition of
+        ``name`` maps to shared generation N (all worlds run the same
+        script, so their generations line up)."""
+        self._uni_gen[name] = self._uni_gen.get(name, 0) + 1
+        self.world.uloop_seed(name, self._uni_gen[name])
+
+    def _check_uni_lengths(self, v: _Var):
+        """All universe/uloop variables must agree on num (they advance
+        in lockstep off one counter — variable.cpp:221-224)."""
+        for other in self._vars.values():
+            if other is not v and other.style in ("universe", "uloop") \
+                    and other.num != v.num:
+                raise MRError("All universe/uloop variables must have "
+                              "same # of values")
 
     # -- retrieval (reference Variable::retrieve) ---------------------------
     def find(self, name: str) -> Optional[_Var]:
@@ -165,6 +277,18 @@ class Variables:
         if style in ("string", "equal", "world"):
             raise MRError("Invalid variable style with next command")
         exhausted = False
+        if style == "uni":
+            # claim the next unprocessed index from the universe-shared
+            # counter; every listed variable jumps to it
+            # (variable.cpp:345-383)
+            nextindex = self.world.uloop_next()
+            for n in names:
+                v = self._vars[n]
+                v.which = nextindex
+                if v.which >= v.num:
+                    exhausted = True
+                    del self._vars[n]
+            return exhausted
         for n in names:
             v = self._vars[n]
             v.which += 1

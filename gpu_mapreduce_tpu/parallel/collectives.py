@@ -37,7 +37,7 @@ def gather_kv(backend, mr, nprocs: int):
     if skv is None:
         return  # host-resident data is already "gathered"
     n = min(nprocs, backend.nprocs)
-    out = exchange(skv, ("fixed_mod", n, backend.mesh),
+    out = exchange(skv, ("fixed_mod", n),
                    transport=mr.settings.all2all, counters=mr.counters)
     _replace_kv_frames(mr.kv, out)
 

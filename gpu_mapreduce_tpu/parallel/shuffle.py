@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.frame import KVFrame
 from ..ops.hash import hash_words32
-from .mesh import (AXIS, flat_axis_index, mesh_axes, mesh_axis_size,
+from .mesh import (flat_axis_index, mesh_axes, mesh_axis_size,
                    row_sharding, row_spec)
 from .sharded import ShardedKV, round_cap, shard_frame
 
@@ -125,7 +125,9 @@ def _ring_exchange(send, mesh):
 
     def body(s, carry):
         buf, recv = carry
-        buf = lax.ppermute(buf, axes if len(axes) > 1 else axes[0], perm)
+        # flat 1-axis mesh only: _exchange_blocks/_exchange_counts route
+        # every 2-axis mesh through _a2a_hier before reaching the ring
+        buf = lax.ppermute(buf, axes[0], perm)
         recv = recv.at[(me - s) % nprocs].set(buf[me])
         return buf, recv
 
@@ -178,7 +180,7 @@ def _compact(recv, counts_from, cap_out: int):
     return packed, jnp.sum(counts_from)
 
 
-def _dest_fn(dest, nprocs: int) -> Callable:
+def _dest_fn(dest, nprocs: int, mesh) -> Callable:
     """Destination spec → per-row dest function.  Specs are hashable so
     the jitted phase1 caches across calls (the iterative graph commands
     re-shuffle every round; re-jitting per round was the dominant cost):
@@ -193,7 +195,6 @@ def _dest_fn(dest, nprocs: int) -> Callable:
         return lambda keys: fn(keys) % nprocs
     if kind == "fixed_mod":
         n = dest[1]
-        mesh = dest[2]
 
         def fixed(keys):
             me = flat_axis_index(mesh)
@@ -219,7 +220,7 @@ def _phase1_cached(mesh, dest):
 
 def _phase1_build(mesh, dest):
     nprocs = mesh_axis_size(mesh)
-    dest_of = _dest_fn(dest, nprocs)
+    dest_of = _dest_fn(dest, nprocs, mesh)
     spec = row_spec(mesh)
 
     @jax.jit

@@ -1,0 +1,262 @@
+"""Multi-world (-partition) Universe tests.
+
+Reference semantics under test: world layout parsing
+(oink/universe.cpp:55-99), per-world sub-communicators + screen/log
+files (oink/oink.cpp:138-236), WORLD/UNIVERSE/ULOOP variable styles and
+the shared-counter ULOOP work sharing (oink/variable.cpp:166-240,
+345-383).  Worlds here are interpreter threads over sub-meshes of the
+8-device fake cluster (tests/conftest.py).
+"""
+
+import re
+
+import pytest
+
+from gpu_mapreduce_tpu.core.runtime import MRError
+from gpu_mapreduce_tpu.oink.universe import Universe, run_universe
+from gpu_mapreduce_tpu.oink.variables import (UloopCounter, Variables,
+                                              WorldContext)
+
+
+# ---------------------------------------------------------------------------
+# Universe layout (reference universe.cpp:55-99)
+# ---------------------------------------------------------------------------
+
+def test_add_world_specs():
+    u = Universe(8)
+    u.add_world("2x3")
+    u.add_world("2")
+    assert u.nworlds == 3
+    assert u.procs_per_world == [3, 3, 2]
+    assert u.root_proc == [0, 3, 6]
+    assert u.consistent()
+
+
+def test_add_world_default_all_procs():
+    u = Universe(8)
+    u.add_world(None)
+    assert u.procs_per_world == [8] and u.consistent()
+
+
+def test_inconsistent_partitions_raise(tmp_path):
+    script = tmp_path / "in.empty"
+    script.write_text("print done\n")
+    with pytest.raises(MRError, match="inconsistent"):
+        run_universe(str(script), ["3x1"], comm=None, uscreen=False,
+                     logname="none", screenname="none")
+
+
+# ---------------------------------------------------------------------------
+# variable styles under a world context (reference variable.cpp:166-240)
+# ---------------------------------------------------------------------------
+
+def test_world_variable_picks_partition_value():
+    v = Variables(WorldContext(1, 3, UloopCounter(3)))
+    v.set(["w", "world", "a", "b", "c"])
+    assert v.retrieve("w") == "b"
+
+
+def test_world_variable_count_mismatch():
+    v = Variables(WorldContext(0, 2, UloopCounter(2)))
+    with pytest.raises(MRError, match="World variable count"):
+        v.set(["w", "world", "a", "b", "c"])
+
+
+def test_universe_count_below_nworlds():
+    v = Variables(WorldContext(0, 4, UloopCounter(4)))
+    with pytest.raises(MRError, match="count < # of partitions"):
+        v.set(["u", "universe", "a", "b"])
+
+
+def test_uni_vars_must_share_length():
+    v = Variables(WorldContext(0, 1, UloopCounter(1)))
+    v.set(["a", "uloop", "4"])
+    with pytest.raises(MRError, match="same # of values"):
+        v.set(["b", "universe", "x", "y", "z"])
+
+
+def test_uloop_is_zero_based_and_starts_at_iworld():
+    # reference: ULOOP offset stays 0 (variable.cpp:196-201), initial
+    # which = iworld (:226)
+    counter = UloopCounter(2)
+    v0 = Variables(WorldContext(0, 2, counter))
+    v1 = Variables(WorldContext(1, 2, counter))
+    for v in (v0, v1):
+        v.set(["u", "uloop", "5"])
+    assert v0.retrieve("u") == "0"
+    assert v1.retrieve("u") == "1"
+    # next claims 2, 3, 4 across the worlds, then exhausts
+    assert v0.next(["u"]) is False and v0.retrieve("u") == "2"
+    assert v1.next(["u"]) is False and v1.retrieve("u") == "3"
+    assert v1.next(["u"]) is False and v1.retrieve("u") == "4"
+    assert v1.next(["u"]) is True          # claimed 5 >= num → exhausted
+
+
+def test_uloop_pad_uses_total_count():
+    v = Variables()
+    v.set(["u", "uloop", "10", "pad"])
+    assert v.retrieve("u") == "00"         # digits of N=10, 0-based
+
+
+def test_uloop_single_world_matches_loop_progression():
+    # nworlds=1: which 0, then next → 1, 2, ... (reference serial run)
+    v = Variables()
+    v.set(["u", "uloop", "3"])
+    seen = [v.retrieve("u")]
+    while not v.next(["u"]):
+        seen.append(v.retrieve("u"))
+    assert seen == ["0", "1", "2"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end -partition runs (threads over sub-meshes)
+# ---------------------------------------------------------------------------
+
+def test_partition_world_variable_and_logs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    script = tmp_path / "in.world"
+    script.write_text('variable p equal nprocs\n'
+                      'variable w world alpha beta\n'
+                      'print "world=$w nprocs=${p}"\n')
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    uni = run_universe(str(script), ["2x4"], comm=make_mesh(8),
+                       uscreen=False)
+    assert uni.nworlds == 2
+    log0 = (tmp_path / "log.oink.0").read_text()
+    log1 = (tmp_path / "log.oink.1").read_text()
+    assert "world=alpha nprocs=4" in log0
+    assert "world=beta nprocs=4" in log1
+    # default per-world screen files exist (reference screen.N)
+    assert (tmp_path / "screen.0").exists()
+    assert (tmp_path / "screen.1").exists()
+    s0 = (tmp_path / "screen.0").read_text()
+    assert "Processor partition = 0" in s0
+
+
+def test_partition_uloop_work_sharing(tmp_path, monkeypatch):
+    """Two worlds drain one 6-index ULOOP: indices are claimed exactly
+    once across worlds (the lock-file work queue, variable.cpp:345-383)."""
+    monkeypatch.chdir(tmp_path)
+    script = tmp_path / "in.uloop"
+    script.write_text('variable u uloop 6\n'
+                      'label top\n'
+                      'print "claimed $u"\n'
+                      'next u\n'
+                      'jump SELF top\n')
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    run_universe(str(script), ["2x4"], comm=make_mesh(8), uscreen=False)
+    claimed = []
+    for i in (0, 1):
+        text = (tmp_path / f"log.oink.{i}").read_text()
+        claimed += [int(m) for m in re.findall(r"claimed (\d+)", text)]
+    assert sorted(claimed) == [0, 1, 2, 3, 4, 5]
+
+
+def test_partition_runs_mapreduce_per_world(tmp_path, monkeypatch):
+    """Each world drives its own sub-mesh MapReduce (wordfreq-style
+    count on generated RMAT edges) without interference."""
+    monkeypatch.chdir(tmp_path)
+    script = tmp_path / "in.rmat"
+    script.write_text('variable w world 0 1\n'
+                      'rmat 6 4 0.25 0.25 0.25 0.25 0.0 ${w} '
+                      '-o NULL edges$w\n'
+                      'degree 0 -i edges$w -o deg.$w NULL\n')
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    run_universe(str(script), ["2x4"], comm=make_mesh(8), uscreen=False,
+                 screenname="none")
+    for w in (0, 1):
+        out = (tmp_path / f"deg.{w}").read_text()
+        assert len(out.splitlines()) > 0
+
+
+def test_cli_partition_requires_in(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.oink.script import main
+
+    with pytest.raises(SystemExit, match="-in"):
+        main(["-partition", "1x1"])
+
+
+def test_cli_partition_builds_mesh(tmp_path, monkeypatch):
+    """The CLI must size a mesh to the specs (2x4 on the 8 fake devices)
+    and produce per-world logs — not fail the consistency check."""
+    monkeypatch.chdir(tmp_path)
+    script = tmp_path / "in.cli"
+    script.write_text('variable w world a b\nprint "w=$w"\n')
+    from gpu_mapreduce_tpu.oink.script import main
+
+    rc = main(["-in", str(script), "-partition", "2x4",
+               "-screen", "none"])
+    assert rc == 0
+    assert "w=a" in (tmp_path / "log.oink.0").read_text()
+    assert "w=b" in (tmp_path / "log.oink.1").read_text()
+
+
+def test_cli_screen_file_not_touched_under_partition(tmp_path, monkeypatch):
+    """-screen FILE with -partition must produce FILE.N only — the bare
+    FILE must not be created/truncated by argument parsing."""
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "scr").write_text("precious")
+    script = tmp_path / "in.cli"
+    script.write_text('print "hi"\n')
+    from gpu_mapreduce_tpu.oink.script import main
+
+    main(["-in", str(script), "-partition", "1", "-screen", "scr",
+          "-log", "none"])
+    assert (tmp_path / "scr").read_text() == "precious"
+    assert "hi" in (tmp_path / "scr.0").read_text()
+
+
+def test_second_uloop_reseeds_counter():
+    """A second uloop variable later in the same table starts fresh —
+    the reference reseeds its lock file at definition from universe
+    proc 0 (variable.cpp:215-219)."""
+    v = Variables()
+    v.set(["a", "uloop", "3"])
+    while not v.next(["a"]):
+        pass
+    v.set(["b", "uloop", "5"])
+    seen = [v.retrieve("b")]
+    while not v.next(["b"]):
+        seen.append(v.retrieve("b"))
+    assert seen == ["0", "1", "2", "3", "4"]
+
+
+def test_world_setup_failure_is_reported(tmp_path, monkeypatch):
+    """A world that cannot even open its log must surface in the
+    universe error, not vanish into the thread's excepthook."""
+    monkeypatch.chdir(tmp_path)
+    script = tmp_path / "in.ok"
+    script.write_text('print "hi"\n')
+    with pytest.raises(MRError, match="world 0"):
+        run_universe(str(script), ["1"], comm=None, uscreen=False,
+                     screenname="none",
+                     logname=str(tmp_path / "no-such-dir" / "log"))
+
+
+def test_script_error_reported_per_world(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    script = tmp_path / "in.bad"
+    script.write_text("definitely_not_a_command\n")
+    with pytest.raises(MRError, match="Unknown command"):
+        run_universe(str(script), ["1"], comm=None, uscreen=False,
+                     screenname="none", logname="none")
+
+
+def test_pagerank_sharded_on_multislice_mesh():
+    """pagerank_sharded must accept a multi-slice ("s","c") mesh and
+    agree with the flat-mesh result."""
+    import numpy as np
+
+    from gpu_mapreduce_tpu.models.pagerank import pagerank_sharded
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh, make_mesh2
+
+    rng = np.random.default_rng(3)
+    n, m = 64, 256
+    src = rng.integers(0, n, m).astype(np.int32)
+    dst = rng.integers(0, n, m).astype(np.int32)
+    r_flat, _ = pagerank_sharded(make_mesh(8), src, dst, n, maxiter=20)
+    r_2d, _ = pagerank_sharded(make_mesh2(2, 4), src, dst, n, maxiter=20)
+    np.testing.assert_allclose(r_flat, r_2d, rtol=1e-5)
