@@ -59,7 +59,7 @@ class _Spilled:
         with np.load(self.path, allow_pickle=True) as z:
             key = _col_from_npz(z, "k")
             value = _col_from_npz(z, "v")
-        counters.rsize += self.bytes_
+        counters.add(rsize=self.bytes_)
         return KVFrame(key, value)
 
 
@@ -91,7 +91,7 @@ def _write_spill(settings: Settings, counters: Counters, name: str,
     path = os.path.join(settings.fpath,
                         f"mrtpu.{name}.{fileid}.{seq}.npz")
     np.savez(path, **payload)
-    counters.wsize += nbytes
+    counters.add(wsize=nbytes)
     return path
 
 
@@ -292,7 +292,7 @@ class _SpilledKMV:
             values = _col_from_npz(z, "v")
             nvalues = z["nv"]
             offsets = z["off"]
-        counters.rsize += self.bytes_
+        counters.add(rsize=self.bytes_)
         return KMVFrame(key, nvalues, offsets, values)
 
 

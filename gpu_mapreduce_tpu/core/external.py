@@ -102,7 +102,7 @@ class _Run:
             self._load(self.vpath, self.pos, stop, self.vkind))
         self.sur = sort_surrogate(self.buf.key if by == "key"
                                   else self.buf.value)
-        self.counters.rsize += self.buf.nbytes()
+        self.counters.add(rsize=self.buf.nbytes())
         self.pos = stop
 
     def exhausted(self) -> bool:
@@ -163,7 +163,7 @@ def _write_run(fr: KVFrame, settings, counters, seq: int) -> _Run:
     kpath, vpath = base + ".k.npy", base + ".v.npy"
     _save_col(fr.key, kpath)
     _save_col(fr.value, vpath)
-    counters.wsize += fr.nbytes()
+    counters.add(wsize=fr.nbytes())
     return _Run(kpath, vpath, len(fr), counters,
                 _col_kind(fr.key), _col_kind(fr.value))
 

@@ -61,6 +61,35 @@ class SerialBackend:
         return x
 
 
+class _TaskSink:
+    """Per-task KV stand-in for mapstyle-2 worker threads: records the
+    callback's add traffic, replayed into the real KeyValue in task order
+    once all workers finish (KeyValue's append buffers are not
+    thread-safe, and serial replay keeps output order deterministic)."""
+
+    __slots__ = ("_calls",)
+
+    def __init__(self):
+        self._calls: list = []
+
+    def add(self, key, value):
+        self._calls.append(("add", key, value))
+
+    def add_batch(self, keys, values):
+        self._calls.append(("add_batch", keys, values))
+
+    def add_frame(self, frame):
+        self._calls.append(("add_frame", frame))
+
+    def add_kv(self, other):
+        self._calls.append(("add_kv", other))
+
+    def replay(self, kv: KeyValue):
+        for name, *args in self._calls:
+            getattr(kv, name)(*args)
+        self._calls.clear()
+
+
 class MapReduce:
     """One MapReduce object owns at most one KV and/or one KMV
     (reference src/mapreduce.h:43-44)."""
@@ -192,16 +221,70 @@ class MapReduce:
     # ------------------------------------------------------------------
     # map family (reference src/mapreduce.cpp:1044-1642)
     # ------------------------------------------------------------------
+    def _run_tasks(self, kv, tasks, call: Callable) -> int:
+        """Dispatch ``call(itask, payload, sink)`` over an iterable of
+        task payloads, honouring mapstyle (reference map_tasks
+        scheduling, src/mapreduce.cpp:1136-1213).  Returns the task count.
+
+        * 0 chunk / 1 stride — under one controller both reduce to "run
+          every task here", in task order;
+        * 2 master-slave — the reference hands tasks to ranks on demand
+          from a master work queue.  The controller analog is a dynamic
+          thread pool: workers PULL the next task when free (good for
+          I/O-bound file ingestion, where CPython releases the GIL).
+          Each task writes a private buffer; buffers replay into the
+          real KV in task order — so the result is bit-identical to
+          styles 0/1 (*stronger* than the reference, whose master-slave
+          pair order is schedule-dependent) and the KV's normal spill
+          budget applies as tasks complete.  A bounded in-flight window
+          backpressures both the payload producer (chunk readers) and
+          buffered output — peak extra memory is O(window) tasks, never
+          O(ntasks)."""
+        if self.settings.mapstyle != 2:
+            n = 0
+            for itask, payload in enumerate(tasks):
+                call(itask, payload, kv)
+                n += 1
+            return n
+        import os
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        nworkers = max(1, min((os.cpu_count() or 4), 16))
+        window = 4 * nworkers
+        inflight: deque = deque()      # (future, sink) in task order
+        n = 0
+
+        def drain_one():
+            fut, sink = inflight.popleft()
+            fut.result()               # propagate callback exceptions
+            sink.replay(kv)
+
+        with ThreadPoolExecutor(nworkers) as pool:
+            try:
+                for itask, payload in enumerate(tasks):
+                    if len(inflight) >= window:
+                        drain_one()
+                    sink = _TaskSink()
+                    inflight.append(
+                        (pool.submit(call, itask, payload, sink), sink))
+                    n += 1
+                while inflight:
+                    drain_one()
+            except BaseException:
+                for fut, _ in inflight:
+                    fut.cancel()
+                raise
+        return n
+
     def map(self, nmap: int, func: Callable, ptr=None, addflag: int = 0) -> int:
         """Task map: func(itask, kv, ptr) called for nmap tasks
         (reference map(nmap,func,ptr,addflag) → map_tasks,
-        src/mapreduce.cpp:1044-1225).  mapstyle chunk/stride both reduce to
-        'all tasks' under one controller; style 2 (master-slave) degrades to
-        chunk (SURVEY.md §7)."""
+        src/mapreduce.cpp:1044-1225)."""
         t = self._begin_op()
         kv = self._start_map(addflag)
-        for itask in range(nmap):
-            func(itask, kv, ptr)
+        self._run_tasks(kv, range(nmap),
+                        lambda itask, _task, sink: func(itask, sink, ptr))
         n = self._finish_kv("map")
         self._time("map", t)
         return n
@@ -217,8 +300,9 @@ class MapReduce:
             files = [files]
         names = findfiles(files, bool(recurse), bool(readflag))
         kv = self._start_map(addflag)
-        for itask, fname in enumerate(names):
-            func(itask, fname, kv, ptr)
+        self._run_tasks(kv, names,
+                        lambda itask, fname, sink: func(itask, fname, sink,
+                                                        ptr))
         n = self._finish_kv("map_files")
         self._time("map_files", t)
         return n
@@ -250,11 +334,13 @@ class MapReduce:
             self.error.all("No files found for chunked map")
         per_file = max(1, nmap // max(1, len(names)))
         kv = self._start_map(addflag)
-        itask = 0
-        for fname in names:
-            for chunk in file_chunks(fname, per_file, sep, delta):
-                func(itask, chunk, kv, ptr)
-                itask += 1
+        chunks = (chunk for fname in names
+                  for chunk in file_chunks(fname, per_file, sep, delta))
+        # the serial chunk reader feeds the window lazily — under
+        # mapstyle 2 backpressure holds O(window) chunks, not all
+        self._run_tasks(kv, chunks,
+                        lambda itask, chunk, sink: func(itask, chunk, sink,
+                                                        ptr))
         n = self._finish_kv("map_chunks")
         self._time("map_chunks", t)
         return n
@@ -710,7 +796,7 @@ class MapReduce:
     def _time(self, op: str, t: Timer, comm: bool = False):
         dt = t.elapsed()
         if comm:
-            self.counters.commtime += dt
+            self.counters.add(commtime=dt)
         if self.settings.timer:
             print(f"{op} time (secs) = {dt:.6g}")
             if self.settings.timer >= 2:

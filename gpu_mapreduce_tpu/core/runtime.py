@@ -17,9 +17,10 @@ TPU reinterpretations (documented, not silently dropped):
 * ``all2all`` — selects the shuffle transport: 1 = single fused all_to_all
   collective, 0 = ppermute ring (the reference's MPI_Alltoallv vs.
   Irecv/Send ring, ``src/irregular.cpp:254-363``).
-* ``mapstyle`` — 0 chunk / 1 stride task assignment kept; 2 (master-slave
-  MPI work queue) is accepted but falls back to chunk with a warning
-  (SURVEY.md §7: dynamic scheduling dropped by design).
+* ``mapstyle`` — 0 chunk / 1 stride task assignment both reduce to "run
+  all tasks here" under one controller; 2 (the reference's master-slave
+  MPI work queue, src/mapreduce.cpp:1136-1213) is a dynamic thread-pool
+  work queue with deterministic task-order output (MapReduce._run_tasks).
 """
 
 from __future__ import annotations
@@ -48,7 +49,7 @@ class Error:
 
 @dataclass
 class Settings:
-    mapstyle: int = 0       # 0 chunk, 1 stride, 2 master-slave (degraded)
+    mapstyle: int = 0       # 0 chunk, 1 stride, 2 master-slave work queue
     all2all: int = 1        # shuffle transport (fused collective vs ring)
     verbosity: int = 0      # 0 silent, 1 totals, 2 + per-shard histograms
     timer: int = 0          # 0 off, 1 totals, 2 + per-shard histograms
@@ -74,7 +75,11 @@ class Settings:
 
 @dataclass
 class Counters:
-    """Cumulative cross-instance stats (reference mapreduce.h:46-57)."""
+    """Cumulative cross-instance stats (reference mapreduce.h:46-57).
+
+    Updates go through ``add()``/``mem()`` which take a lock — counters
+    are shared across MapReduce objects (global_counters) and mutate
+    from concurrent -partition world threads and mapstyle-2 workers."""
     msize: int = 0          # current bytes resident (HBM frames)
     msizemax: int = 0       # hi-water
     rsize: int = 0          # bytes read from spill files
@@ -83,10 +88,21 @@ class Counters:
     crsize: int = 0         # bytes received in shuffles
     commtime: float = 0.0   # seconds in collectives
 
+    def __post_init__(self):
+        import threading
+        self._lock = threading.Lock()
+
+    def add(self, **deltas):
+        """Atomically bump the named counters: add(rsize=n, wsize=m)."""
+        with self._lock:
+            for name, delta in deltas.items():
+                setattr(self, name, getattr(self, name) + delta)
+
     def mem(self, delta: int):
-        self.msize += delta
-        if self.msize > self.msizemax:
-            self.msizemax = self.msize
+        with self._lock:
+            self.msize += delta
+            if self.msize > self.msizemax:
+                self.msizemax = self.msize
 
 
 class Timer:

@@ -72,7 +72,6 @@ def broadcast_kv(backend, mr, root: int):
                 skv.value.dtype.itemsize *
                 (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
     moved = int(skv.counts[root]) * (backend.nprocs - 1) * rowbytes
-    mr.counters.cssize += moved
-    mr.counters.crsize += moved
+    mr.counters.add(cssize=moved, crsize=moved)
     _replace_kv_frames(mr.kv, ShardedKV(mesh, k, v, counts,
                                         key_decode=skv.key_decode))

@@ -313,8 +313,7 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
                     skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
         moved = int(counts_mat.sum() - np.trace(counts_mat)) * rowbytes
-        counters.cssize += moved
-        counters.crsize += moved
+        counters.add(cssize=moved, crsize=moved)
     return ShardedKV(mesh, out_k, out_v, new_counts,
                      key_decode=skv.key_decode)
 
@@ -366,7 +365,7 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     t = Timer()
     out = exchange(skv, ("hash", hash_fn), transport=mr.settings.all2all,
                    counters=mr.counters)
-    mr.counters.commtime += t.elapsed()
+    mr.counters.add(commtime=t.elapsed())
     _replace_kv_frames(kv, out)
 
 

@@ -350,3 +350,105 @@ def test_intcount_app_mesh(tmp_path, rng):
     nints, nunique, _ = intcount([str(f)], comm=make_mesh(4))
     assert nints == 4096
     assert nunique == len(set(data.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# mapstyle 2: master-slave dynamic work queue (src/mapreduce.cpp:1136-1213)
+# ---------------------------------------------------------------------------
+
+def test_mapstyle2_matches_chunk_order():
+    """The thread-pool work queue must produce a KV bit-identical to the
+    serial chunk schedule (per-task buffers replayed in task order)."""
+    import time as _t
+
+    def slow_uneven(itask, kv, ptr):
+        _t.sleep(0.002 * (itask % 3))      # uneven task durations
+        for i in range(5):
+            kv.add(itask, itask * 10 + i)
+
+    mr0 = MapReduce()
+    mr0.map(12, slow_uneven)
+    mr2 = MapReduce(mapstyle=2)
+    n = mr2.map(12, slow_uneven)
+    assert n == 60
+    assert [p for f in mr2.kv.frames() for p in f.to_host().pairs()] == \
+           [p for f in mr0.kv.frames() for p in f.to_host().pairs()]
+
+
+def test_mapstyle2_map_files(tmp_path):
+    paths = []
+    for i in range(6):
+        p = tmp_path / f"f{i}.txt"
+        p.write_text(f"file {i}")
+        paths.append(str(p))
+
+    def per_file(itask, fname, kv, ptr):
+        kv.add(itask, open(fname).read())
+
+    mr = MapReduce(mapstyle=2)
+    assert mr.map_files(paths, per_file) == 6
+    pairs = sorted(p for f in mr.kv.frames() for p in f.to_host().pairs())
+    assert pairs == [(i, f"file {i}".encode()) for i in range(6)]
+
+
+def test_mapstyle2_map_file_char(tmp_path):
+    data = b"".join(b"line %03d\n" % i for i in range(200))
+    p = tmp_path / "big.txt"
+    p.write_bytes(data)
+
+    def per_chunk(itask, chunk, kv, ptr):
+        kv.add(itask, chunk)
+
+    mr = MapReduce(mapstyle=2)
+    mr.map_file_char(8, str(p), 0, 0, "\n", 32, per_chunk)
+    chunks = [v for f in mr.kv.frames() for _, v in f.to_host().pairs()]
+    assert b"".join(chunks) == data
+
+
+def test_mapstyle2_callback_exception_propagates():
+    def boom(itask, kv, ptr):
+        if itask == 3:
+            raise ValueError("task 3 failed")
+        kv.add(itask, itask)
+
+    mr = MapReduce(mapstyle=2)
+    with pytest.raises(ValueError, match="task 3"):
+        mr.map(8, boom)
+
+
+def test_mapstyle2_outofcore_spills_incrementally(tmp_path):
+    """The work-queue path must honour the spill budget as tasks drain —
+    not buffer the whole map's output (review r2: host OOM risk)."""
+    mr = MapReduce(mapstyle=2, outofcore=1, memsize=1, maxpage=1,
+                   fpath=str(tmp_path))
+
+    def emit_bulk(itask, kv, ptr):
+        kv.add_batch(np.arange(200_000, dtype=np.uint64) + itask,
+                     np.arange(200_000, dtype=np.uint64))
+
+    n = mr.map(8, emit_bulk)
+    assert n == 8 * 200_000
+    import os
+    assert any(f.startswith("mrtpu.") for f in os.listdir(tmp_path))
+
+
+def test_counters_thread_safe():
+    import threading
+
+    from gpu_mapreduce_tpu.core.runtime import Counters
+
+    c = Counters()
+
+    def bump():
+        for _ in range(20_000):
+            c.add(rsize=1)
+            c.mem(1)
+            c.mem(-1)
+
+    ts = [threading.Thread(target=bump) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.rsize == 80_000
+    assert c.msize == 0
