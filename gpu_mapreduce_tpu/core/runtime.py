@@ -25,6 +25,7 @@ TPU reinterpretations (documented, not silently dropped):
 
 from __future__ import annotations
 
+import os
 import time
 import warnings
 from dataclasses import dataclass, field
@@ -53,7 +54,12 @@ class Settings:
     all2all: int = 1        # shuffle transport (fused collective vs ring)
     verbosity: int = 0      # 0 silent, 1 totals, 2 + per-shard histograms
     timer: int = 0          # 0 off, 1 totals, 2 + per-shard histograms
-    memsize: int = 64       # MB per frame (reference default 64, mapreduce.cpp:209)
+    # MB per frame (reference default 64, mapreduce.cpp:209); the env
+    # vars mirror the reference's compile-time default overrides
+    # MRMPI_MEMSIZE / MRMPI_FPATH (mapreduce.cpp:206-229) — explicit
+    # settings still win
+    memsize: int = field(default_factory=lambda: int(
+        os.environ.get("MRTPU_MEMSIZE", 64)))
     minpage: int = 0
     maxpage: int = 0        # max frames resident in HBM; 0 = unlimited
     freepage: int = 1
@@ -61,7 +67,8 @@ class Settings:
     zeropage: int = 0
     keyalign: int = 8       # accepted, ignored (columnar)
     valuealign: int = 8
-    fpath: str = "."        # spill-file directory (reference MRMPI_FPATH)
+    fpath: str = field(default_factory=lambda: os.environ.get(
+        "MRTPU_FPATH", "."))  # spill-file dir (reference MRMPI_FPATH)
 
     def validate(self, error: Error):
         if self.memsize <= 0:
