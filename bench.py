@@ -127,7 +127,10 @@ def run_bench(engine, backend_err):
     if "map_device" in raw:
         map_time = raw["map_device"]
     elif "native_scan" in raw:
-        map_time = raw["native_scan"] + raw.get("host_add", 0.0)
+        # union wall-clock of scan+add spans across the mapstyle-2
+        # worker threads: elapsed time with >=1 thread in the map stage
+        # (equals the plain sum when serial; StageTimer.wall docstring)
+        map_time = idx.timer.wall("map_kernels")
     else:
         map_time = raw.get("map", dt)
     map_time = max(map_time, 1e-9)

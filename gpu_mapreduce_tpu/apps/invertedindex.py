@@ -45,7 +45,7 @@ from jax import lax
 
 from ..core.mapreduce import MapReduce
 from .. import native
-from ..ops.hash import hash_bytes64_batch, hash_bytes64_masked
+from ..ops.hash import hash_bytes64_masked
 from ..ops.pallas.match import (bytes_view_u32, compact_word_matches,
                                 first_byte_pos, mark_words_pallas,
                                 mark_words_xla, mask_words_to_length,
@@ -205,6 +205,21 @@ def _count_collisions(ids, alts, valid):
                     & v[1:] & v[:-1]).astype(jnp.int32))
 
 
+def _url_dict_wanted(files, want_urls: bool) -> bool:
+    """One policy for both tiers: keep URL bytes when output needs them
+    or the corpus is small (URL_DICT_MAX)."""
+    return want_urls or sum(os.path.getsize(f) for f in files) \
+        <= URL_DICT_MAX
+
+
+def _host_collision_count(ids: np.ndarray, alts: np.ndarray) -> int:
+    """#ids carrying two different alt-ids (u64 intern collisions) —
+    host twin of _count_collisions, shared by both tiers."""
+    order = np.lexsort((alts, ids))
+    a, b = ids[order], alts[order]
+    return int(((a[1:] == a[:-1]) & (b[1:] != b[:-1])).sum())
+
+
 def _assemble_parts(parts):
     """Merge per-batch packed device columns into one packed column set.
     Single batch (the common case) is zero-copy; multi-batch concatenates
@@ -237,19 +252,49 @@ def _collision_check_fn():
 class StageTimer:
     """Cumulative wall-clock per pipeline stage (reference instrument:
     gettimeofday/cudaEvent pairs around each kernel,
-    cuda/InvertedIndex.cu:337,360,369,384)."""
+    cuda/InvertedIndex.cu:337,360,369,384).
 
-    def __init__(self):
+    Thread-safe: the native map tier runs callbacks from mapstyle-2
+    worker threads.  ``times`` sums per-invocation durations (CPU-time-
+    like under parallelism).  Stages mapped to a *group* additionally
+    maintain an online span union — :meth:`wall` returns the elapsed
+    time during which at least one thread was inside any stage of the
+    group (the honest parallel metric; equals the plain sum when
+    serial).  Computed with an active-thread counter, O(1) memory —
+    no span list to grow with task count."""
+
+    def __init__(self, groups: Optional[Dict[str, str]] = None):
+        import threading
         self.times: Dict[str, float] = {}
+        self._groups = groups or {}        # stage name → group name
+        self._gactive: Dict[str, tuple] = {}  # group → (depth, t_enter)
+        self._gwall: Dict[str, float] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def stage(self, name: str):
+        g = self._groups.get(name)
         t0 = time.perf_counter()
+        if g is not None:
+            with self._lock:
+                depth, ts = self._gactive.get(g, (0, 0.0))
+                self._gactive[g] = (depth + 1, t0 if depth == 0 else ts)
         try:
             yield
         finally:
-            self.times[name] = (self.times.get(name, 0.0)
-                                + time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            with self._lock:
+                self.times[name] = self.times.get(name, 0.0) + t1 - t0
+                if g is not None:
+                    depth, ts = self._gactive[g]
+                    if depth == 1:
+                        self._gwall[g] = self._gwall.get(g, 0.0) + t1 - ts
+                    self._gactive[g] = (depth - 1, ts)
+
+    def wall(self, group: str) -> float:
+        """Accumulated span-union seconds of the named group."""
+        with self._lock:
+            return self._gwall.get(group, 0.0)
 
 
 class InvertedIndex:
@@ -257,12 +302,17 @@ class InvertedIndex:
 
     def __init__(self, comm=None, use_pallas: Optional[bool] = None,
                  interpret: Optional[bool] = None,
-                 engine: Optional[str] = None):
+                 engine: Optional[str] = None,
+                 mapstyle: Optional[int] = None):
         """engine: 'pallas' (TPU kernels, default), 'xla' (jnp fallback),
         or 'native' (the C++ scanner of native/mrnative.cpp — the moral
         equivalent of the reference's cpu/InvertedIndex.cpp FSM baseline,
         and the host fallback when no accelerator is worth dispatching
-        to)."""
+        to).  mapstyle: map-task scheduling; the native engine defaults
+        to 2 (thread-pool work queue — file reads, the C++ scan and the
+        batch hashing all release the GIL, so files scan in parallel
+        like the reference's one-rank-per-core MPI layout)."""
+        import threading
         backend = jax.default_backend()
         if engine is None:
             engine = "pallas" if (use_pallas or use_pallas is None) \
@@ -279,17 +329,31 @@ class InvertedIndex:
             interpret = not is_tpu_backend(backend)
         self.interpret = interpret
         self.comm = comm
+        self.mapstyle = (2 if engine == "native" else 0) \
+            if mapstyle is None else mapstyle
         self.urls: Dict[int, bytes] = {}
         self.docs: List[str] = []
         self.npairs = 0
-        self.timer = StageTimer()
+        # scan+hash form the "map_kernels" wall group: bench.py compares
+        # its span union against the reference's 44 ms kernel boundary
+        self.timer = StageTimer(groups={"native_scan": "map_kernels",
+                                        "host_add": "map_kernels"})
+        self._intern_lock = threading.Lock()
+        self._keep_bytes = True
+        self._id_check: List[tuple] = []   # (ids, alts) when dict skipped
 
     # -- map stage: native (host C++) tier --------------------------------
+    # device alt-id seed family (see _extract_build): the host twin uses
+    # the same seeds so both tiers' collision checks are comparable
+    _ALT_HI, _ALT_LO = 0x9E3779B9, 0x85EBCA6B
+
     def _map_file_native(self, itask, filename, kv, ptr):
+        """Thread-safe under mapstyle 2: doc id is the task id (docs are
+        preset in run()), the url dict is lock-guarded, and everything
+        between — read, C++ scan, batch hash — releases the GIL."""
         with open(filename, "rb") as f:
             data = np.frombuffer(f.read(), dtype=np.uint8)
-        doc_id = len(self.docs)
-        self.docs.append(filename)
+        doc_id = itask
         if len(data) == 0:
             return
         with self.timer.stage("native_scan"):
@@ -300,10 +364,22 @@ class InvertedIndex:
         lengths = np.where(lengths >= MAX_URL, -1, lengths)
         with self.timer.stage("host_add"):
             keep = lengths >= 0  # unterminated href: reference runs off; we drop
-            urls = [data[st:st + ln].tobytes()
-                    for st, ln in zip(starts[keep], lengths[keep])]
-            ids = hash_bytes64_batch(urls)
-            self._intern(ids, urls)
+            kst, kln = starts[keep], lengths[keep]
+            # zero-copy: hash URLs straight out of the file buffer (the
+            # native engine implies the C++ runtime is loaded)
+            ids = native.intern_ranges(data, kst, kln)
+            if self._keep_bytes:
+                urls = [data[s:s + l].tobytes()
+                        for s, l in zip(kst.tolist(), kln.tolist())]
+                with self._intern_lock:
+                    self._intern(ids, urls)
+            else:
+                # no url dict (URL_DICT_MAX policy, like the device
+                # tier): record an independent alt-id family instead so
+                # run() can still detect u64 intern collisions
+                self._id_check.append(
+                    (ids, native.intern_ranges(data, kst, kln,
+                                               self._ALT_HI, self._ALT_LO)))
             kv.add_batch(ids, np.full(len(ids), doc_id, dtype=np.uint32))
 
     def _intern(self, ids, urls):
@@ -343,8 +419,7 @@ class InvertedIndex:
         parts = []          # per batch: (ids, alts, docs, npairs) device
         corpora = []        # per batch: (corpus, ustarts, lengths, ids)
         doc_base = 0
-        keep_bytes = want_urls or sum(
-            os.path.getsize(f) for f in files) <= URL_DICT_MAX
+        keep_bytes = _url_dict_wanted(files, want_urls)
         for batch in self._file_batches(files):
             with self.timer.stage("read"):
                 corpus, fstarts = _build_corpus(batch)
@@ -404,10 +479,7 @@ class InvertedIndex:
                 ids_h = np.asarray(ids[:npairs])
                 alts_h = np.asarray(alts[:npairs])
                 kv.add_batch(ids_h, np.asarray(docs[:npairs]))
-                order = np.lexsort((alts_h, ids_h))
-                a, b = ids_h[order], alts_h[order]
-                ncoll = (int(((a[1:] == a[:-1])
-                              & (b[1:] != b[:-1])).sum()) if multi else 0)
+                ncoll = _host_collision_count(ids_h, alts_h) if multi else 0
             if ncoll:
                 raise ValueError(
                     f"{ncoll} 64-bit URL intern collision(s) detected "
@@ -437,14 +509,28 @@ class InvertedIndex:
         """Returns (total hits, unique urls).  Writes `url \\t files` lines
         to outdir/part-<proc> when outdir is given (reference myreduce,
         cuda/InvertedIndex.cu:463-513)."""
-        mr = MapReduce(self.comm)
+        mr = MapReduce(self.comm, mapstyle=self.mapstyle)
         self._mr = mr
         files = findfiles(list(paths))
         if nfiles is not None:
             files = files[:nfiles]
         with self.timer.stage("map"):
             if self.engine == "native":
+                # doc ids are task ids (stable under the mapstyle-2
+                # work queue's out-of-order execution)
+                self.docs = list(files)
+                self._keep_bytes = _url_dict_wanted(files,
+                                                    outdir is not None)
+                self._id_check = []
                 self.npairs = mr.map_files(files, self._map_file_native)
+                if self._id_check:
+                    ncoll = _host_collision_count(
+                        np.concatenate([c[0] for c in self._id_check]),
+                        np.concatenate([c[1] for c in self._id_check]))
+                    if ncoll:
+                        raise ValueError(f"{ncoll} 64-bit URL intern "
+                                         f"collision(s) detected")
+                    self._id_check = []
             else:
                 self.npairs = mr.map(
                     1, lambda itask, kv, ptr: self._map_corpus_device(

@@ -69,6 +69,9 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.mr_hashlittle_batch.argtypes = [u8p, p(i64), i64, u32, p(u32)]
     lib.mr_intern64_batch.restype = None
     lib.mr_intern64_batch.argtypes = [u8p, p(i64), i64, p(u64)]
+    lib.mr_intern_ranges.argtypes = [u8p, p(i64), p(i64), i64, u32, u32,
+                                     p(u64)]
+    lib.mr_intern_ranges.restype = None
     lib.mr_parse_table.restype = i64
     lib.mr_parse_table.argtypes = [u8p, i64, i64, p(ctypes.c_int32),
                                    p(ctypes.c_void_p), i64]
@@ -109,6 +112,25 @@ def hashlittle_batch(buf: bytes, offsets: np.ndarray,
     out = np.empty(n, np.uint32)
     _lib.mr_hashlittle_batch(_u8(buf), _arr(offsets, ctypes.c_int64), n,
                              initval, _arr(out, ctypes.c_uint32))
+    return out
+
+
+def intern_ranges(buf: np.ndarray, starts: np.ndarray, lens: np.ndarray,
+                  seed_hi: int = 0, seed_lo: int = 0xDEADBEEF) -> np.ndarray:
+    """u64 ids over (start, len) ranges of ``buf`` — zero-copy interning
+    straight out of a file buffer (default seeds = the intern family of
+    hash_bytes64; alternate seeds = an independent check family)."""
+    n = len(starts)
+    starts = np.ascontiguousarray(starts, np.int64)
+    lens = np.ascontiguousarray(lens, np.int64)
+    out = np.empty(n, np.uint64)
+    if isinstance(buf, np.ndarray):
+        ptr = _arr(np.ascontiguousarray(buf, np.uint8), ctypes.c_uint8)
+    else:
+        ptr = _u8(buf)
+    _lib.mr_intern_ranges(ptr, _arr(starts, ctypes.c_int64),
+                          _arr(lens, ctypes.c_int64), n, seed_hi, seed_lo,
+                          _arr(out, ctypes.c_uint64))
     return out
 
 

@@ -107,6 +107,25 @@ void mr_intern64_batch(const uint8_t *buf, const int64_t *offsets,
   }
 }
 
+// 64-bit ids over (start, len) ranges of one buffer — the zero-copy
+// variant of mr_intern64_batch: the InvertedIndex native tier hashes
+// URLs straight out of the file buffer, no per-URL Python slicing or
+// repacking (the reference's map callback likewise works in place on
+// its chunk buffer, cpu/InvertedIndex.cpp:144-265).  Seeds select the
+// id family: (0, 0xDEADBEEF) is the intern family shared with the
+// device tier; alternate seeds give the independent collision-check
+// family (apps/invertedindex.py).
+void mr_intern_ranges(const uint8_t *buf, const int64_t *starts,
+                      const int64_t *lens, int64_t n, uint32_t seed_hi,
+                      uint32_t seed_lo, uint64_t *out) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t *p = buf + starts[i];
+    uint64_t hi = hashlittle(p, lens[i], seed_hi);
+    uint64_t lo = hashlittle(p, lens[i], seed_lo);
+    out[i] = (hi << 32) | lo;
+  }
+}
+
 // numeric table parser (read_edge / read_edge_weight ingestion):
 // whitespace-separated tokens parsed round-robin per column; colspec[j]:
 // 0 = u64 (exact integer parse), 1 = f64 (strtod).  cols[j] points at a
@@ -185,16 +204,25 @@ int64_t mr_find_hrefs(const uint8_t *buf, int64_t len, int64_t *starts,
   static const char pat[] = "<a href=\"";
   const int64_t plen = 9;
   int64_t n = 0;
-  for (int64_t i = 0; i + plen <= len; i++) {
-    if (memcmp(buf + i, pat, plen) != 0) continue;
-    int64_t s = i + plen;
-    int64_t e = s;
-    while (e < len && buf[e] != '"') e++;
-    if (e >= len) break;
-    if (n < max) { starts[n] = s; lens[n] = e - s; }
-    n++;
-    // no skip: the device mark kernel flags every pattern position, and
-    // a match can legally start inside a prior URL span
+  // memchr-driven: jump '<' to '<' (SIMD in libc) instead of a
+  // memcmp at every byte — the scan runs at memory bandwidth on
+  // tag-sparse text and still wins on dense HTML
+  for (int64_t i = 0; i + plen <= len; ) {
+    const void *hit = memchr(buf + i, '<', len - plen - i + 1);
+    if (hit == nullptr) break;
+    i = (const uint8_t *)hit - buf;
+    if (i + plen > len) break;
+    if (memcmp(buf + i, pat, plen) == 0) {
+      int64_t s = i + plen;
+      const void *q = memchr(buf + s, '"', len - s);
+      if (q == nullptr) break;
+      int64_t e = (const uint8_t *)q - buf;
+      if (n < max) { starts[n] = s; lens[n] = e - s; }
+      n++;
+    }
+    // advance one byte only: the device mark kernel flags every pattern
+    // position, and a match can legally start inside a prior URL span
+    i++;
   }
   return n <= max ? n : -n;
 }

@@ -131,3 +131,32 @@ def test_kernels_parse_cols_native_path(tmp_path):
     assert vi.tolist() == [5, 18446744073709551615]
     assert vj.tolist() == [6, 2]
     assert w.tolist() == [1.5, 0.25]
+
+
+def test_intern_ranges_matches_batch():
+    """Zero-copy range interning must agree with the packed-buffer intern
+    and the seeded alt family must differ from the default family."""
+    rnd = random.Random(5)
+    data = bytes(rnd.randrange(256) for _ in range(4096))
+    buf = np.frombuffer(data, np.uint8)
+    starts = np.array([0, 10, 100, 1000, 4000], np.int64)
+    lens = np.array([5, 0, 33, 300, 96], np.int64)
+    ids = native.intern_ranges(buf, starts, lens)
+    pieces = [data[s:s + l] for s, l in zip(starts, lens)]
+    offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+    expect = native.intern64_batch(b"".join(pieces), offs)
+    np.testing.assert_array_equal(ids, expect)
+    alt = native.intern_ranges(buf, starts, lens, 0x9E3779B9, 0x85EBCA6B)
+    assert not np.array_equal(ids, alt)
+
+
+def test_find_hrefs_edge_positions():
+    # pattern flush at start / end-of-buffer, quote at last byte,
+    # unterminated tail, '<' density
+    html = b'<a href="x"' + b"<<<<" + b'<a href="yy"'
+    s, l = native.find_hrefs(html)
+    got = [html[a:a + b] for a, b in zip(s, l)]
+    assert got == [b"x", b"yy"]
+    assert native.find_hrefs(b'<a href="')[0].size == 0   # no quote
+    assert native.find_hrefs(b"")[0].size == 0
+    assert native.find_hrefs(b"<" * 64)[0].size == 0
