@@ -552,7 +552,14 @@ class InvertedIndex:
             kv.add(key, len(values))
 
         def emit_batch(fr, kv, ptr):
-            # device tier: vectorised count per group, no host round trip
+            # vectorised count per group for both tiers: sharded frames
+            # reduce on device; host KMVFrames already carry the count
+            # (nvalues) — no per-group Python either way
+            from ..core.frame import KMVFrame
+            if isinstance(fr, KMVFrame):
+                nurl[0] += len(fr)
+                kv.add_batch(fr.key, fr.nvalues.astype(np.int64))
+                return
             from ..parallel.group import reduce_sharded
             counted = reduce_sharded(fr, "count")
             nurl[0] += len(counted)
@@ -563,10 +570,9 @@ class InvertedIndex:
                 os.makedirs(outdir, exist_ok=True)
                 out = open(os.path.join(outdir, "part-00000"), "w")
             with self.timer.stage("reduce"):
-                device_tier = (out is None and self.kmv_is_sharded(mr))
-                if device_tier:
+                if out is None:     # counting only: vectorised both tiers
                     mr.reduce(emit_batch, batch=True)
-                else:
+                else:               # url/doc name output: per-group host
                     mr.reduce(emit_host)
         finally:
             if out is not None:
@@ -574,8 +580,3 @@ class InvertedIndex:
         self.mr = mr
         return self.npairs, nurl[0]
 
-    @staticmethod
-    def kmv_is_sharded(mr) -> bool:
-        from ..core.frame import KMVFrame
-        return (mr.kmv is not None
-                and any(not isinstance(f, KMVFrame) for f in mr.kmv.frames()))
