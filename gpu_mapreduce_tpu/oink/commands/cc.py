@@ -15,6 +15,8 @@ component with its minimum vertex id (deterministic across backends)."""
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ...core.runtime import MRError
@@ -229,10 +231,23 @@ def zone_reassign(fr, kv, ptr):
 class CCFind(Command):
     """cc_find nthresh: connected components of an edge list; output is
     (Vi, Zi) with Zi = min vertex id of Vi's component
-    (oink/cc_find.cpp:38-109)."""
+    (oink/cc_find.cpp:38-109).
+
+    Two engines, same fixpoint (min-vertex-id zones):
+
+    * ``fused`` (default) — the whole convergence loop is ONE jitted
+      ``lax.while_loop`` (models/cc.py): two segment-mins + pointer
+      jumping per round, edges mesh-sharded, labels replicated, one
+      pmin over ICI per round.  ~1000× the composed engine on XLA,
+      where each MR stage is a compiled program.
+    * ``composed`` — the reference's 9-stage MapReduce composition
+      (below), kept as the parity demonstration of the op algebra's
+      device tier; select with GPUMR_CC_ENGINE=composed (or by setting
+      ``CCFind.engine``)."""
 
     ninputs = 1
     noutputs = 1
+    engine: str | None = None   # None → GPUMR_CC_ENGINE env (or fused)
 
     def params(self, args):
         if len(args) != 1:
@@ -240,6 +255,52 @@ class CCFind(Command):
         self.nthresh = int(args[0])  # accepted for parity; see module doc
 
     def run(self):
+        engine = self.engine or os.environ.get("GPUMR_CC_ENGINE", "fused")
+        if engine not in ("fused", "composed"):
+            raise MRError(f"cc_find: unknown engine {engine!r} "
+                          f"(use 'fused' or 'composed')")
+        if engine == "composed":
+            return self._run_composed()
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+
+        edges: list = []
+        mre.scan_kv(lambda fr, p: edges.append(kv_keys(fr)), batch=True)
+        e = (np.concatenate(edges) if edges
+             else np.zeros((0, 2), np.uint64))
+        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+        n = len(verts)
+        if n == 0:
+            self.ncc, self.niterate = 0, 0
+            mrv = obj.create_mr()
+            obj.output(1, mrv, print_vertex_value)
+            self.message("CC_find: 0 components in 0 iterations")
+            obj.cleanup()
+            return
+        src = inv.reshape(-1, 2)[:, 0]
+        dst = inv.reshape(-1, 2)[:, 1]
+
+        from jax.sharding import Mesh
+
+        from ...models.cc import cc, cc_sharded
+        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        if mesh is not None:
+            labels, iters = cc_sharded(mesh, src, dst, n)
+        else:
+            labels, iters = cc(src.astype(np.int32), dst.astype(np.int32), n)
+            labels, iters = np.asarray(labels), int(iters)
+
+        zones = verts[labels]               # min vertex id per component
+        self.ncc = int(len(np.unique(labels)))
+        self.niterate = int(iters)
+        mrv = obj.create_mr()
+        mrv.map(1, lambda i, kv, p: kv.add_batch(verts, zones))
+        obj.output(1, mrv, print_vertex_value)
+        self.message(f"CC_find: {self.ncc} components in "
+                     f"{self.niterate} iterations")
+        obj.cleanup()
+
+    def _run_composed(self):
         obj = self.obj
         mre = obj.input(1, read_edge)
         mre.aggregate()   # mesh: shard the edge list once; every iteration

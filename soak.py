@@ -26,6 +26,12 @@ import numpy as np
 
 
 def main():
+    # honour JAX_PLATFORMS before any device access — the axon plugin's
+    # register() overrides the env var, and a hung TPU tunnel would
+    # otherwise block the whole soak (the round-1 bench failure mode;
+    # weakscale.py and bench.py already pin)
+    from gpu_mapreduce_tpu.utils.platform import pin_platform
+    pin_platform()
     import jax
     jax.config.update("jax_enable_x64", True)
     from gpu_mapreduce_tpu.models.rmat import generate_unique
@@ -104,7 +110,10 @@ def main():
 
     with open("BASELINE.json") as f:
         base = json.load(f)
-    base["published"] = published
+    # merge under a backend-qualified key — never wipe records other
+    # harnesses own (bench.py's invertedindex numbers) and never let a
+    # CPU re-run clobber a previous real-TPU soak
+    base.setdefault("published", {})[f"soak_{backend}"] = published
     with open("BASELINE.json", "w") as f:
         json.dump(base, f, indent=2)
     print("BASELINE.json published:", json.dumps(published))

@@ -54,6 +54,41 @@ def test_cc_find_matches_union_find(graph_file, tmp_path):
     assert cmd.ncc == len(set(oracle.values()))
 
 
+def test_cc_find_fused_equals_composed(graph_file, tmp_path, monkeypatch):
+    """Both engines must produce identical (vertex, zone) outputs and
+    component counts — same min-vertex-id fixpoint."""
+    from gpu_mapreduce_tpu.oink.commands import cc as ccmod
+
+    path, e = graph_file
+    outs = {}
+    for engine in ("fused", "composed"):
+        monkeypatch.setattr(ccmod.CCFind, "engine", engine)
+        out = tmp_path / f"cc.{engine}"
+        cmd = run_command("cc_find", ["0"], inputs=[path],
+                          outputs=[str(out)], screen=False)
+        outs[engine] = (cmd.ncc,
+                        np.loadtxt(out, dtype=np.uint64).reshape(-1, 2))
+    assert outs["fused"][0] == outs["composed"][0]
+    f = {tuple(r) for r in outs["fused"][1]}
+    c = {tuple(r) for r in outs["composed"][1]}
+    assert f == c
+
+
+def test_cc_find_fused_on_mesh(graph_file, tmp_path):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    path, e = graph_file
+    out = tmp_path / "cc.out"
+    obj = ObjectManager(comm=make_mesh(8))
+    cmd = run_command("cc_find", ["0"], obj=obj, inputs=[path],
+                      outputs=[str(out)], screen=False)
+    oracle = union_find_labels(e, np.unique(e))
+    got = {int(a): int(b) for a, b in
+           np.loadtxt(out, dtype=np.uint64).reshape(-1, 2)}
+    assert got == oracle
+    assert cmd.ncc == len(set(oracle.values()))
+
+
 def test_cc_find_single_component(tmp_path):
     # a path graph 0-1-2-...-19: one component, worst case for propagation
     e = np.stack([np.arange(19), np.arange(1, 20)], 1).astype(np.uint64)
@@ -96,15 +131,19 @@ def test_cc_find_on_mesh_backend(graph_file, tmp_path):
     assert cmd.ncc == len(set(oracle.values()))
 
 
-def test_cc_find_mesh_stays_on_device(tmp_path):
-    """VERDICT r1 #3 'done' criterion: cc_find's iteration loop on the
-    mesh backend must never materialise a frame on the host — all kernels
-    run their device (shard_map) tier.  RMAT graph, union-find oracle."""
+def test_cc_find_mesh_stays_on_device(tmp_path, monkeypatch):
+    """VERDICT r1 #3 'done' criterion: the COMPOSED cc_find engine's
+    iteration loop on the mesh backend must never materialise a frame on
+    the host — all kernels run their device (shard_map) tier.  RMAT
+    graph, union-find oracle.  (The default fused engine satisfies this
+    trivially — the whole loop is one dispatch — so this test pins the
+    composed MR pipeline.)"""
     from gpu_mapreduce_tpu.models.rmat import generate_unique
     from gpu_mapreduce_tpu.oink.commands import cc as ccmod
     from gpu_mapreduce_tpu.parallel.mesh import make_mesh
     from gpu_mapreduce_tpu.parallel.sharded import ToHostStats
 
+    monkeypatch.setattr(ccmod.CCFind, "engine", "composed")
     e, _ = generate_unique(seed=42, nlevels=10, nnonzero=4,
                            abcd=(0.57, 0.19, 0.19, 0.05), frac=0.1)
     e = e[e[:, 0] != e[:, 1]].astype(np.uint64)
