@@ -72,10 +72,14 @@ def generate_unique(seed: int, nlevels: int, nnonzero: int,
     root = jax.random.PRNGKey(seed)
     seen = np.zeros((0, 2), np.uint64)
     niterate = 0
+    # ONE generation shape for every round: a per-round pow2 of the
+    # remaining need meant a fresh XLA compile per round (~7 compiles —
+    # 20-40s each on real TPU); the full-size batch trimmed to `need`
+    # keeps the exact reference semantics with a single compile
+    m = max(8, 1 << (ntotal - 1).bit_length())
     while len(seen) < ntotal:
         niterate += 1
         need = ntotal - len(seen)
-        m = max(8, 1 << (need - 1).bit_length())   # pow2 → few compiles
         root, sub = jax.random.split(root)
         vi, vj = rmat_edges(sub, m, nlevels, jnp.asarray(abcd), frac,
                             noisy=frac > 0.0)

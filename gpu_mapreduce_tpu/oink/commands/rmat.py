@@ -36,9 +36,11 @@ class _RmatBase(Command):
         self.order = 1 << self.nlevels
 
     def _generate(self, key, nremain: int) -> np.ndarray:
-        """One round of device edge generation, pow2-padded for compile
-        reuse, trimmed to nremain rows."""
-        m = max(8, 1 << (nremain - 1).bit_length())
+        """One round of device edge generation, trimmed to nremain rows.
+        The generation shape is the SAME every round (pow2 of the total
+        edge count, not of the shrinking remainder) so the jitted
+        generator compiles once per command, not once per cull round."""
+        m = max(8, 1 << (self.order * self.nnonzero - 1).bit_length())
         vi, vj = rmat_edges(key, m, self.nlevels, np.asarray(self.abcd),
                             self.frac, noisy=self.frac > 0.0)
         return np.stack([np.asarray(vi)[:nremain],
