@@ -1,0 +1,136 @@
+"""Luby maximal independent set — fused on-device rounds.
+
+The reference iterates {edge_winner, vert_winner, vert_loser,
+vert_emit} MapReduce stages until no edges remain
+(``oink/luby_find.cpp:53-95``); the composed twin lives in
+oink/commands/luby.py.  This model runs the whole thing in ONE jitted
+``lax.while_loop`` over a dense vertex state vector:
+
+* per-vertex priorities are the SAME splitmix64 stream as the composed
+  engine (``vertex_rand(v, seed)`` on original ids), so both engines
+  select the same winners — a vertex joins when its (priority, id) is
+  lexicographically smaller than every UNDECIDED neighbour's;
+* one round = masked segment-mins (neighbour min priority, then min id
+  among holders of it) + neighbour-of-winner exclusion, all
+  vectorised; the mesh version pmin/pmax-combines over ICI.
+
+States: 0 undecided, 1 in MIS, 2 excluded.  A vertex whose undecided
+neighbourhood empties (everyone excluded) sees +inf and joins — the
+maximality guarantee."""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import mesh_axes, mesh_axis_size, row_spec
+
+
+def _both_dirs(src, dst, x_by_src):
+    """Edge contributions in both directions: (values, targets) where
+    value i is x evaluated at the *other* endpoint."""
+    return (jnp.concatenate([x_by_src[src], x_by_src[dst]]),
+            jnp.concatenate([dst, src]),
+            jnp.concatenate([src, dst]))
+
+
+def _round(state, prio, src, dst, valid, n, axes=None):
+    und = state == 0
+    idx = jnp.arange(n, dtype=jnp.int32)
+    active = valid & und[src] & und[dst]
+    act2 = jnp.concatenate([active, active])
+
+    pv, tgt, other = _both_dirs(src, dst, prio)
+    seg = jnp.where(act2, tgt, n)
+    ov = other.astype(jnp.int32)
+
+    # min neighbour priority among undecided neighbours
+    m1 = jax.ops.segment_min(jnp.where(act2, pv, jnp.inf), seg,
+                             num_segments=n + 1)[:n]
+    if axes is not None:
+        m1 = lax.pmin(m1, axes)
+    # min neighbour id among holders of that priority (tie-break)
+    hold = act2 & (pv == m1[tgt])
+    mid = jax.ops.segment_min(jnp.where(hold, ov, n), seg,
+                              num_segments=n + 1)[:n]
+    if axes is not None:
+        mid = lax.pmin(mid, axes)
+
+    winner = und & ((prio < m1) | ((prio == m1) & (idx < mid)))
+
+    # neighbours of winners become excluded (only undecided ones change)
+    wv = jnp.concatenate([winner[src], winner[dst]]).astype(jnp.int32)
+    seg_all = jnp.where(jnp.concatenate([valid, valid]), tgt, n)
+    wn = jax.ops.segment_max(jnp.where(seg_all < n, wv, 0), seg_all,
+                             num_segments=n + 1)[:n]
+    if axes is not None:
+        wn = lax.pmax(wn, axes)
+    lose = und & ~winner & (wn > 0)
+    return jnp.where(winner, 1, jnp.where(lose, 2, state)).astype(jnp.int8)
+
+
+def _loop(step, n, maxiter):
+    state0 = jnp.zeros(n, jnp.int8)
+
+    def cond(s):
+        state, it = s
+        return jnp.logical_and(jnp.any(state == 0), it < maxiter)
+
+    def body(s):
+        state, it = s
+        return step(state), it + 1
+
+    return lax.while_loop(cond, body, (state0, jnp.int32(0)))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "maxiter"))
+def luby_mis(src, dst, prio, n: int, maxiter: int = 0
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Single device.  Returns (state[n] ∈ {1 MIS, 2 excluded}, rounds).
+    ``prio``: per-vertex priorities (vertex_rand on original ids)."""
+    maxiter = maxiter or max(n, 1)
+    valid = jnp.ones(src.shape, bool)
+    s32, d32 = src.astype(jnp.int32), dst.astype(jnp.int32)
+    return _loop(lambda st: _round(st, prio, s32, d32, valid, n),
+                 n, maxiter)
+
+
+@functools.lru_cache(maxsize=None)
+def _luby_sharded_fn(mesh: Mesh, n: int, maxiter: int):
+    axes = mesh_axes(mesh)
+    rspec = row_spec(mesh)
+    rep = NamedSharding(mesh, P())
+
+    @functools.partial(jax.jit, out_shardings=(rep, rep))
+    def run(src_d, dst_d, valid_d, prio):
+        body = jax.shard_map(
+            lambda st, pr, s, d, v: _round(st, pr, s, d, v, n, axes),
+            mesh=mesh, in_specs=(P(), P(), rspec, rspec, rspec),
+            out_specs=P())
+        return _loop(lambda st: body(st, prio, src_d, dst_d, valid_d),
+                     n, maxiter)
+
+    return run
+
+
+def luby_mis_sharded(mesh: Mesh, src: np.ndarray, dst: np.ndarray,
+                     prio: np.ndarray, n: int, maxiter: int = 0
+                     ) -> Tuple[np.ndarray, int]:
+    from ..models.pagerank import pad_edges_for_mesh
+
+    nprocs = mesh_axis_size(mesh)
+    src_p, dst_p, valid_p = pad_edges_for_mesh(
+        src.astype(np.int32), dst.astype(np.int32), nprocs)
+    shard = NamedSharding(mesh, row_spec(mesh))
+    run = _luby_sharded_fn(mesh, n, maxiter or max(n, 1))
+    state, iters = run(jax.device_put(src_p, shard),
+                       jax.device_put(dst_p, shard),
+                       jax.device_put(valid_p, shard),
+                       jnp.asarray(prio))
+    return np.asarray(state), int(iters)

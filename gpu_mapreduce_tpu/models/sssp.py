@@ -1,0 +1,137 @@
+"""Single-source shortest paths — fused on-device Bellman-Ford.
+
+The reference's sssp command relaxes distances through ~6 MapReduce
+stages per round (``oink/sssp.cpp:49-180``); like cc_find, that
+composition pays one compiled XLA program per stage per shape, and the
+iterative driver drowns in recompiles (SURVEY.md §7).  The fused model
+runs the whole relaxation to fixpoint in ONE jitted ``lax.while_loop``:
+
+* ``dist`` is a dense replicated vector (vertices pre-densified by the
+  command, like PageRank/cc);
+* one round = one ``segment_min`` of ``dist[src] + w`` over the
+  (sharded) edge list, plus a second masked ``segment_min`` that picks
+  the smallest source achieving the new distance as the predecessor;
+* the mesh version pmin-combines both over ICI; the only host traffic
+  is the final (dist, pred).
+
+The source vertex is a TRACED operand, so the ncnt-source experiment
+(``sssp ncnt seed``) reuses one compiled program for every source.
+Predecessor ties break to the smallest vertex index (any pred that
+realises the shortest distance is valid — the oracle contract)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import mesh_axes, mesh_axis_size, row_spec
+
+
+def _round(dist, pred, src, dst, w, valid, n, axes=None):
+    """One relaxation round; with ``axes`` the partial mins combine
+    across mesh shards via pmin."""
+    seg = jnp.where(valid, dst, n)
+    relax = jnp.where(valid, dist[src] + w, jnp.inf)
+    m = jax.ops.segment_min(relax, seg, num_segments=n + 1)[:n]
+    if axes is not None:
+        m = lax.pmin(m, axes)
+    nd = jnp.minimum(dist, m)
+    improved = nd < dist
+    cand = jnp.where(valid & (relax == nd[dst]), src, n).astype(jnp.int32)
+    pm = jax.ops.segment_min(cand, seg, num_segments=n + 1)[:n]
+    if axes is not None:
+        pm = lax.pmin(pm, axes)
+    npred = jnp.where(improved, pm, pred)
+    return nd, npred, jnp.any(improved)
+
+
+def _loop(step, n, maxiter, source):
+    dist0 = jnp.full((n,), jnp.inf).at[source].set(0.0)
+    pred0 = jnp.full((n,), -1, jnp.int32)
+
+    def cond(state):
+        return jnp.logical_and(state[2], state[3] < maxiter)
+
+    def body(state):
+        dist, pred, _, it = state
+        nd, npred, changed = step(dist, pred)
+        return nd, npred, changed, it + 1
+
+    dist, pred, _, iters = lax.while_loop(
+        cond, body, (dist0, pred0, jnp.bool_(True), jnp.int32(0)))
+    return dist, pred, iters
+
+
+@functools.partial(jax.jit, static_argnames=("n", "maxiter"))
+def bellman_ford(src, dst, w, n: int, source, maxiter: int = 0):
+    """Single device.  Returns (dist[n], pred[n], iterations); pred is
+    -1 for the source and unreachable vertices."""
+    maxiter = maxiter or max(n, 1)
+    valid = jnp.ones(src.shape, bool)
+    s32, d32 = src.astype(jnp.int32), dst.astype(jnp.int32)
+
+    def step(dist, pred):
+        return _round(dist, pred, s32, d32, w, valid, n)
+
+    return _loop(step, n, maxiter, source)
+
+
+@functools.lru_cache(maxsize=None)
+def _bf_sharded_fn(mesh: Mesh, n: int, maxiter: int):
+    axes = mesh_axes(mesh)
+    rspec = row_spec(mesh)
+    rep = NamedSharding(mesh, P())
+
+    @functools.partial(jax.jit, out_shardings=(rep, rep, rep))
+    def run(src_d, dst_d, w_d, valid_d, source):
+        body = jax.shard_map(
+            lambda dist, pred, s, d, w, v: _round(dist, pred, s, d, w, v,
+                                                  n, axes),
+            mesh=mesh, in_specs=(P(), P(), rspec, rspec, rspec, rspec),
+            out_specs=(P(), P(), P()))
+
+        def step(dist, pred):
+            return body(dist, pred, src_d, dst_d, w_d, valid_d)
+
+        return _loop(step, n, maxiter, source)
+
+    return run
+
+
+def prepare_bellman_ford(mesh: Mesh, src: np.ndarray, dst: np.ndarray,
+                         w: np.ndarray, n: int, maxiter: int = 0):
+    """Pad + upload the edge arrays ONCE; returns ``run(source) →
+    (dist, pred, iters)`` — the ncnt-source experiment re-uses both the
+    compiled program and the device-resident edges."""
+    from ..models.pagerank import pad_edges_for_mesh
+
+    nprocs = mesh_axis_size(mesh)
+    src_p, dst_p, valid_p = pad_edges_for_mesh(
+        src.astype(np.int32), dst.astype(np.int32), nprocs)
+    w_p = np.concatenate([np.asarray(w, np.float64),
+                          np.zeros(len(src_p) - len(w))])
+    shard = NamedSharding(mesh, row_spec(mesh))
+    fn = _bf_sharded_fn(mesh, n, maxiter or max(n, 1))
+    args = (jax.device_put(src_p, shard), jax.device_put(dst_p, shard),
+            jax.device_put(w_p, shard), jax.device_put(valid_p, shard))
+
+    def run(source: int):
+        dist, pred, iters = fn(*args, jnp.int32(source))
+        return np.asarray(dist), np.asarray(pred), int(iters)
+
+    return run
+
+
+def bellman_ford_sharded(mesh: Mesh, src: np.ndarray, dst: np.ndarray,
+                         w: np.ndarray, n: int, source: int,
+                         maxiter: int = 0
+                         ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Edge-parallel fused loop over a device mesh (single source; for
+    many sources use :func:`prepare_bellman_ford`)."""
+    return prepare_bellman_ford(mesh, src, dst, w, n, maxiter)(source)

@@ -32,6 +32,8 @@ Two TPU-first redesigns vs the reference:
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ...core.runtime import MRError
@@ -251,10 +253,19 @@ def copy_edge(fr, kv, ptr):
 @command("luby_find")
 class LubyFind(Command):
     """luby_find seed: maximal independent set of an undirected edge list;
-    output is one MIS vertex per line (oink/luby_find.cpp:53-115)."""
+    output is one MIS vertex per line (oink/luby_find.cpp:53-115).
+
+    Engines: ``fused`` (default) — the whole round loop in one jitted
+    ``lax.while_loop`` over a dense state vector with the SAME splitmix64
+    per-vertex priorities as the composed engine (models/luby.py);
+    ``composed`` — the reference's 5-stage MR round below
+    (GPUMR_LUBY_ENGINE=composed).  Both are valid MIS constructions;
+    selected sets can differ because the composed engine's winner rule is
+    edge-local per round."""
 
     ninputs = 1
     noutputs = 1
+    engine: str | None = None   # None → GPUMR_LUBY_ENGINE env (or fused)
 
     def params(self, args):
         if len(args) != 1:
@@ -262,6 +273,56 @@ class LubyFind(Command):
         self.seed = int(args[0])
 
     def run(self):
+        engine = self.engine or os.environ.get("GPUMR_LUBY_ENGINE", "fused")
+        if engine not in ("fused", "composed"):
+            raise MRError(f"luby_find: unknown engine {engine!r} "
+                          f"(use 'fused' or 'composed')")
+        if engine == "composed":
+            return self._run_composed()
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+
+        ecols: list = []
+        mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)), batch=True)
+        e = (np.concatenate(ecols) if ecols
+             else np.zeros((0, 2), np.uint64)).astype(np.uint64)
+        e = e[e[:, 0] != e[:, 1]]            # self-loops never block a MIS
+        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+        n = len(verts)
+        if n == 0:
+            self.nset, self.niterate = 0, 0
+            mrv = obj.create_mr()
+            obj.output(1, mrv, print_vertex)
+            self.message("Luby_find: 0 MIS vertices in 0 iterations")
+            obj.cleanup()
+            return
+        src = inv.reshape(-1, 2)[:, 0]
+        dst = inv.reshape(-1, 2)[:, 1]
+        prio = vertex_rand(verts, self.seed)
+
+        from jax.sharding import Mesh
+
+        from ...models.luby import luby_mis, luby_mis_sharded
+        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        if mesh is not None:
+            state, iters = luby_mis_sharded(mesh, src, dst, prio, n)
+        else:
+            state, iters = luby_mis(src.astype(np.int32),
+                                    dst.astype(np.int32),
+                                    jnp.asarray(prio), n)
+            state, iters = np.asarray(state), int(iters)
+
+        mis = verts[state == 1]
+        self.nset, self.niterate = int(len(mis)), int(iters)
+        mrv = obj.create_mr()
+        mrv.map(1, lambda i, kv, p: kv.add_batch(
+            mis, np.zeros(len(mis), np.uint8)))
+        obj.output(1, mrv, print_vertex)
+        self.message(f"Luby_find: {self.nset} MIS vertices in "
+                     f"{self.niterate} iterations")
+        obj.cleanup()
+
+    def _run_composed(self):
         obj = self.obj
         mre = obj.input(1, read_edge)
         mre.aggregate()   # mesh: shard once; the round loop below then

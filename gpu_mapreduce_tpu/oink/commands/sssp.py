@@ -40,6 +40,8 @@ TPU-first redesigns vs the reference:
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from ...core.runtime import MRError
@@ -267,10 +269,18 @@ class SSSPCommand(Command):
     """sssp ncnt seed: shortest paths from ncnt deterministic-random
     sources over a directed weighted edge list (oink/sssp.cpp).  Output
     per source: 'v dist pred' lines (path suffixed .<i> when ncnt > 1);
-    self.results[source] = {v: (dist, pred)}."""
+    self.results[source] = {v: (dist, pred)}.
+
+    Engines (same contract — any pred realising the shortest distance):
+    ``fused`` (default) — whole Bellman-Ford relaxation in one jitted
+    ``lax.while_loop`` with the source as a traced operand, so every
+    source of the ncnt experiment reuses ONE compiled program
+    (models/sssp.py); ``composed`` — the reference's per-round MR
+    composition below (GPUMR_SSSP_ENGINE=composed)."""
 
     ninputs = 1
     noutputs = 1
+    engine: str | None = None   # None → GPUMR_SSSP_ENGINE env (or fused)
 
     def params(self, args):
         if len(args) != 2:
@@ -279,6 +289,95 @@ class SSSPCommand(Command):
         self.seed = int(args[1])
 
     def run(self):
+        engine = self.engine or os.environ.get("GPUMR_SSSP_ENGINE", "fused")
+        if engine not in ("fused", "composed"):
+            raise MRError(f"sssp: unknown engine {engine!r} "
+                          f"(use 'fused' or 'composed')")
+        if engine == "composed":
+            return self._run_composed()
+        obj = self.obj
+        mredge = obj.input(1, read_edge_weight)
+
+        ecols: list = []
+        mredge.scan_kv(lambda fr, p: ecols.append(
+            (kv_keys(fr), kv_values(fr))), batch=True)
+        if ecols:
+            e = np.concatenate([c[0] for c in ecols]).astype(np.uint64)
+            w = np.concatenate([c[1] for c in ecols]).astype(np.float64)
+        else:
+            e = np.zeros((0, 2), np.uint64)
+            w = np.zeros(0, np.float64)
+        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+        n = len(verts)
+        if n == 0:
+            raise MRError("sssp: empty edge list")
+        src = inv.reshape(-1, 2)[:, 0]
+        dst = inv.reshape(-1, 2)[:, 1]
+
+        # deterministic-random source list (same ranking as composed)
+        order = np.lexsort((verts, vertex_rand(verts, self.seed)))
+        sources = verts[order][:self.ncnt].tolist()
+
+        from jax.sharding import Mesh
+
+        from ...models.sssp import bellman_ford, prepare_bellman_ford
+        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        if mesh is not None:
+            # pad + upload the edges ONCE; every source reuses the
+            # compiled program and the device-resident arrays
+            bf = prepare_bellman_ford(mesh, src, dst, w, n)
+        else:
+            s32 = src.astype(np.int32)
+            d32 = dst.astype(np.int32)
+            w_d = jnp.asarray(w)
+
+            def bf(sidx):
+                dist, pred, it = bellman_ford(s32, d32, w_d, n,
+                                              jnp.int32(sidx))
+                return np.asarray(dist), np.asarray(pred), int(it)
+
+        self.results = {}
+        self.niters = {}
+        outd = obj.outputs[0] if obj.outputs else None
+        dist = np.full(n, np.inf)
+        pred = np.full(n, -1, np.int64)
+        for cnt, source in enumerate(sources):
+            sidx = int(np.searchsorted(verts, np.uint64(source)))
+            dist, pred, niter = bf(sidx)
+            # dict/file view: -1 (source/unreachable) renders as 0 like
+            # the composed output path (np.maximum(..., 0))
+            predv = np.where(pred >= 0, verts[np.maximum(pred, 0)],
+                             np.uint64(0))
+            res = {int(v): (float(d), int(p))
+                   for v, d, p in zip(verts, dist, predv)}
+            self.results[source] = res
+            self.niters[source] = niter
+            nlabeled = int(np.isfinite(dist).sum())
+            self.message(f"SSSP: source {source}: {niter} iterations, "
+                         f"{nlabeled} vertices labeled")
+            if outd is not None and outd.path is not None:
+                path = (f"{outd.path}.{cnt}" if self.ncnt > 1
+                        else outd.path)
+                with open(path, "w") as fp:
+                    for v in sorted(res):
+                        d, p = res[v]
+                        fp.write(f"{v} {d:g} {p}\n")
+        if outd is not None and outd.mr_name is not None:
+            # named-MR rows keep the composed engine's persisted shape:
+            # [TAG_DIST, pred (original id, NO_PRED sentinel intact),
+            # dist, current=1] — a consumer can tell "no predecessor"
+            # from "predecessor is vertex 0" (see module docstring)
+            predf = np.where(pred >= 0,
+                             verts[np.maximum(pred, 0)].astype(np.float64),
+                             NO_PRED)
+            mrv = obj.create_mr()
+            rows = np.stack([np.full(n, TAG_DIST), predf, dist,
+                             np.ones(n)], axis=1)
+            mrv.map(1, lambda i, kv, p: kv.add_batch(verts, rows))
+            obj.name_mr(outd.mr_name, mrv)
+        obj.cleanup()
+
+    def _run_composed(self):
         obj = self.obj
         mredge = obj.input(1, read_edge_weight)
         mredge.aggregate()   # mesh: shard once; the relaxation loop stays

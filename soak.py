@@ -90,6 +90,41 @@ def main():
         print(f"cc_find: {cmd.ncc} components, {cmd.niterate} iters, "
               f"{dt:.2f}s -> {len(sub) / per_iter:,.0f} edges/s/iter")
 
+    # -- sssp (fused Bellman-Ford; one compiled program, traced source)
+    from gpu_mapreduce_tpu.models.sssp import bellman_ford_sharded
+    nv = 1 << scale
+    srcv = edges[:, 0].astype(np.int32)
+    dstv = edges[:, 1].astype(np.int32)
+    w = np.random.default_rng(7).uniform(0.5, 5.0, len(edges))
+    t0 = time.perf_counter()
+    titers = 0
+    for s in (0, 1, 2, 3):
+        _, _, it = bellman_ford_sharded(mesh, srcv, dstv, w, nv, s)
+        titers += max(1, it)
+    dt = time.perf_counter() - t0
+    published["sssp_edges_per_sec_per_iter"] = round(
+        nedges / (dt / titers), 1) if titers else 0.0
+    print(f"sssp: 4 sources, {titers} total iters, {dt:.2f}s -> "
+          f"{nedges / (dt / titers):,.0f} edges/s/iter")
+
+    # -- luby MIS (fused rounds) ---------------------------------------
+    from gpu_mapreduce_tpu.models.luby import luby_mis_sharded
+    from gpu_mapreduce_tpu.oink.commands.luby import vertex_rand
+    uverts, uinv = np.unique(edges.reshape(-1), return_inverse=True)
+    lsrc = uinv.reshape(-1, 2)[:, 0]
+    ldst = uinv.reshape(-1, 2)[:, 1]
+    keep = lsrc != ldst
+    prio = vertex_rand(uverts, 99)
+    t0 = time.perf_counter()
+    state, lit = luby_mis_sharded(mesh, lsrc[keep], ldst[keep], prio,
+                                  len(uverts))
+    dt = time.perf_counter() - t0
+    published["luby_edges_per_sec_per_iter"] = round(
+        int(keep.sum()) / (dt / max(1, lit)), 1)
+    print(f"luby: {int((state == 1).sum())} MIS vertices, {lit} rounds, "
+          f"{dt:.2f}s -> {int(keep.sum()) / (dt / max(1, lit)):,.0f} "
+          f"edges/s/round")
+
     # -- pagerank (north-star metric) ----------------------------------
     n = 1 << scale
     src = edges[:, 0].astype(np.int32)

@@ -193,9 +193,12 @@ def _spy_snapshots(module, kernel_name):
     return snaps, lambda: setattr(module, kernel_name, orig)
 
 
-def test_luby_mesh_stays_on_device(graph_file, tmp_path):
+def test_luby_mesh_stays_on_device(graph_file, tmp_path, monkeypatch):
+    """Pins the COMPOSED engine's device tier (the default fused engine
+    is one dispatch for the whole loop — trivially on-device)."""
     from gpu_mapreduce_tpu.oink.commands import luby as lmod
     from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    monkeypatch.setattr(lmod.LubyFind, "engine", "composed")
     path, e = graph_file
     snaps, restore = _spy_snapshots(lmod, "edge_winner")
     try:
@@ -209,9 +212,12 @@ def test_luby_mesh_stays_on_device(graph_file, tmp_path):
     assert snaps[-1] == snaps[0], f"host materialisation in loop: {snaps}"
 
 
-def test_sssp_mesh_stays_on_device(tmp_path, rng):
+def test_sssp_mesh_stays_on_device(tmp_path, rng, monkeypatch):
+    """Pins the COMPOSED engine's device tier (the default fused engine
+    is one dispatch for the whole loop — trivially on-device)."""
     from gpu_mapreduce_tpu.oink.commands import sssp as smod
     from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    monkeypatch.setattr(smod.SSSPCommand, "engine", "composed")
     e = rng.integers(0, 40, size=(150, 2)).astype(np.uint64)
     e = e[e[:, 0] != e[:, 1]]
     w = rng.uniform(0.1, 2.0, len(e))
@@ -357,6 +363,21 @@ def test_luby_find_is_maximal_independent(graph_file, tmp_path, seed):
     assert cmd.nset == len(got)
 
 
+def test_luby_fused_serial_equals_mesh(graph_file, tmp_path):
+    """The fused engine must pick the identical MIS on the serial and
+    mesh backends (same priorities, deterministic lexicographic rule)."""
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    path, e = graph_file
+    o1, o2 = tmp_path / "a.out", tmp_path / "b.out"
+    run_command("luby_find", ["7"], inputs=[path], outputs=[str(o1)],
+                screen=False)
+    obj = ObjectManager(comm=make_mesh(8))
+    run_command("luby_find", ["7"], obj=obj, inputs=[path],
+                outputs=[str(o2)], screen=False)
+    assert sorted(o1.read_text().split()) == sorted(o2.read_text().split())
+
+
 def test_luby_find_complete_graph(tmp_path):
     # K6: MIS is exactly one vertex, one round
     e = np.array([(a, b) for a in range(6) for b in range(a + 1, 6)],
@@ -446,6 +467,25 @@ def test_sssp_matches_dijkstra(weighted_graph_file, tmp_path):
     # file round-trip
     rows = [l.split() for l in out.read_text().splitlines()]
     assert len(rows) == len(oracle)
+
+
+def test_sssp_fused_equals_composed(weighted_graph_file, monkeypatch):
+    """Both engines must agree on distances for every source (preds may
+    differ on ties; each is separately validated vs Dijkstra)."""
+    from gpu_mapreduce_tpu.oink.commands import sssp as smod
+
+    path, ew = weighted_graph_file
+    res = {}
+    for engine in ("fused", "composed"):
+        monkeypatch.setattr(smod.SSSPCommand, "engine", engine)
+        cmd = run_command("sssp", ["2", "17"], inputs=[path], screen=False)
+        res[engine] = cmd.results
+    assert set(res["fused"]) == set(res["composed"])
+    for source in res["fused"]:
+        f, c = res["fused"][source], res["composed"][source]
+        assert set(f) == set(c)
+        for v in f:
+            assert f[v][0] == pytest.approx(c[v][0])
 
 
 def test_sssp_multi_source_line_graph(tmp_path):
@@ -549,3 +589,16 @@ def test_neigh_tri_per_vertex_files(tri_file, tmp_path):
         assert {p[1] for p in nb_lines} == adj[v]
         want_tris = {t for t in tris if v in t}
         assert {frozenset((v,) + p) for p in tri_lines} == want_tris
+
+
+def test_sssp_zero_sources_named_output(weighted_graph_file):
+    """sssp 0 <seed> with a named-MR output must not crash (review r2:
+    loop-local vars in the named-MR block)."""
+    from gpu_mapreduce_tpu.oink.objects import ObjectManager as OM
+
+    path, _ = weighted_graph_file
+    obj = ObjectManager()
+    cmd = run_command("sssp", ["0", "5"], obj=obj, inputs=[path],
+                      outputs=[(None, "named")], screen=False)
+    assert cmd.results == {}
+    assert "named" in obj.named
