@@ -7,9 +7,12 @@ oink/commands/luby.py.  This model runs the whole thing in ONE jitted
 ``lax.while_loop`` over a dense vertex state vector:
 
 * per-vertex priorities are the SAME splitmix64 stream as the composed
-  engine (``vertex_rand(v, seed)`` on original ids), so both engines
-  select the same winners — a vertex joins when its (priority, id) is
-  lexicographically smaller than every UNDECIDED neighbour's;
+  engine (``vertex_rand(v, seed)`` on original ids); a vertex joins
+  when its (priority, id) is lexicographically smaller than every
+  UNDECIDED neighbour's.  With these shared priorities the two engines
+  produce identical sets on the golden script input, but only the MIS
+  property itself is contractual (the composed rounds cull edges in a
+  different order — see the LubyFind docstring);
 * one round = masked segment-mins (neighbour min priority, then min id
   among holders of it) + neighbour-of-winner exclusion, all
   vectorised; the mesh version pmin/pmax-combines over ICI.

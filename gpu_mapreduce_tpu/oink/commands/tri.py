@@ -202,16 +202,49 @@ def print_tri(k, v, fp):
 class TriFind(Command):
     """tri_find: enumerate all triangles of an edge list; output one
     (Vi,Vj,Vk) line per triangle, Vi = the low-degree "center" vertex that
-    emitted the angle (oink/tri_find.cpp:43-81)."""
+    emitted the angle (oink/tri_find.cpp:43-81).
+
+    Engines: ``fused`` (default) — vectorised degree-ordered wedge
+    matching (models/tri.py: index arithmetic + batched searchsorted
+    membership, no shuffled angle materialisation); ``composed`` — the
+    reference's 6-stage MR pipeline below (GPUMR_TRI_ENGINE=composed).
+    Identical triangle sets."""
 
     ninputs = 1
     noutputs = 1
+    engine: str | None = None   # None → GPUMR_TRI_ENGINE env (or fused)
 
     def params(self, args):
         if args:
             raise MRError("Illegal tri_find command")
 
     def run(self):
+        engine = self.engine or os.environ.get("GPUMR_TRI_ENGINE", "fused")
+        if engine not in ("fused", "composed"):
+            raise MRError(f"tri_find: unknown engine {engine!r} "
+                          f"(use 'fused' or 'composed')")
+        if engine == "composed":
+            return self._run_composed()
+        obj = self.obj
+        mre = obj.input(1, read_edge)
+
+        ecols: list = []
+        mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)), batch=True)
+        e = (np.concatenate(ecols) if ecols
+             else np.zeros((0, 2), np.uint64)).astype(np.uint64)
+
+        from ...models.tri import triangles
+        tris = triangles(e)
+
+        self.ntri = len(tris)
+        mrt = obj.create_mr()
+        mrt.map(1, lambda i, kv, p: kv.add_batch(
+            tris, np.zeros(len(tris), np.uint8)))
+        obj.output(1, mrt, print_tri)
+        self.message(f"Tri_find: {self.ntri} triangles")
+        obj.cleanup()
+
+    def _run_composed(self):
         obj = self.obj
         mre = obj.input(1, read_edge)
         mre.aggregate()   # mesh: shard once; all stages below stay

@@ -236,9 +236,11 @@ def test_sssp_mesh_stays_on_device(tmp_path, rng, monkeypatch):
     assert snaps[-1] == snaps[1], f"host materialisation in loop: {snaps}"
 
 
-def test_tri_mesh_stays_on_device(tri_file, tmp_path):
+def test_tri_mesh_stays_on_device(tri_file, tmp_path, monkeypatch):
+    """Pins the COMPOSED engine's device tier."""
     from gpu_mapreduce_tpu.oink.commands import tri as tmod
     from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    monkeypatch.setattr(tmod.TriFind, "engine", "composed")
     path, e = tri_file
     s1, restore1 = _spy_snapshots(tmod, "first_degree")
     s2, restore2 = _spy_snapshots(tmod, "emit_triangles")
@@ -296,6 +298,22 @@ def test_tri_find_matches_brute_force(tri_file, tmp_path):
     got = {frozenset(map(int, row)) for row in got_rows}
     assert got == oracle
     assert cmd.ntri == len(oracle) == len(got_rows)  # each exactly once
+
+
+def test_tri_find_fused_equals_composed(tri_file, tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.oink.commands import tri as tmod
+
+    path, e = tri_file
+    tris = {}
+    for engine in ("fused", "composed"):
+        monkeypatch.setattr(tmod.TriFind, "engine", engine)
+        out = tmp_path / f"tri.{engine}"
+        cmd = run_command("tri_find", [], inputs=[path],
+                          outputs=[str(out)], screen=False)
+        rows = np.loadtxt(out, dtype=np.uint64).reshape(-1, 3)
+        tris[engine] = {frozenset(map(int, r)) for r in rows}
+        assert cmd.ntri == len(rows)
+    assert tris["fused"] == tris["composed"]
 
 
 def test_tri_find_triangle_free(tmp_path):
@@ -594,8 +612,6 @@ def test_neigh_tri_per_vertex_files(tri_file, tmp_path):
 def test_sssp_zero_sources_named_output(weighted_graph_file):
     """sssp 0 <seed> with a named-MR output must not crash (review r2:
     loop-local vars in the named-MR block)."""
-    from gpu_mapreduce_tpu.oink.objects import ObjectManager as OM
-
     path, _ = weighted_graph_file
     obj = ObjectManager()
     cmd = run_command("sssp", ["0", "5"], obj=obj, inputs=[path],
