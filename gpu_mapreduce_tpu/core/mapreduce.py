@@ -474,13 +474,14 @@ class MapReduce:
         the reference interleaves raw bytes; we interleave typed rows and
         refuse to silently coerce across types."""
         kv = self._require_kv("collapse")
-        fr = kv.one_frame().to_host()
-        rows: list = []
-        for k, v in fr.pairs():
-            rows.append(k)
-            rows.append(v)
-        values = _interleave_rows(rows, self.error)
-        n = len(rows)
+        parts: List[Column] = []
+        for fr in kv.frames():      # spilled frames stream one at a time
+            fr = fr.to_host()
+            if len(fr):
+                parts.append(_interleave_frame(fr, self.error))
+        values = concat(parts) if parts \
+            else DenseColumn(np.zeros(0, np.int64))
+        n = len(values)
         kmv_frame = KMVFrame(_rows_to_column([key]), np.asarray([n]),
                              np.asarray([0, n]), values)
         kv.free()
@@ -822,6 +823,33 @@ def _rows_to_column(rows: list) -> Column:
                             for r in rows])
     from .dataset import rows_to_array
     return DenseColumn(rows_to_array(rows))
+
+
+def _interleave_frame(fr: KVFrame, error: Error) -> Column:
+    """Vectorised collapse() interleave of one frame: [k1,v1,k2,v2,...].
+    Dense same-shape columns use a strided write (no per-row Python);
+    bytes interleave as lists; anything ragged/object falls back to the
+    per-row path."""
+    k, v = fr.key, fr.value
+    n = len(fr)
+    if isinstance(k, BytesColumn) and isinstance(v, BytesColumn):
+        out: list = [None] * (2 * n)
+        out[0::2] = list(k.data)
+        out[1::2] = list(v.data)
+        return BytesColumn(out)
+    if isinstance(k, DenseColumn) and isinstance(v, DenseColumn):
+        ka, va = np.asarray(k.data), np.asarray(v.data)
+        if ka.shape[1:] == va.shape[1:]:
+            dt = np.promote_types(ka.dtype, va.dtype)
+            arr = np.empty((2 * n,) + ka.shape[1:], dt)
+            arr[0::2] = ka
+            arr[1::2] = va
+            return DenseColumn(arr)
+    rows: list = [None] * (2 * n)
+    kl, vl = k.tolist(), v.tolist()
+    rows[0::2] = kl
+    rows[1::2] = vl
+    return _interleave_rows(rows, error)
 
 
 def _interleave_rows(rows: list, error: Error) -> Column:

@@ -452,3 +452,24 @@ def test_counters_thread_safe():
         t.join()
     assert c.rsize == 80_000
     assert c.msize == 0
+
+
+def test_collapse_spilled_multiframe(tmp_path):
+    """collapse() streams spilled frames (vectorised interleave) and
+    matches the in-core result."""
+    keys = np.arange(50_000, dtype=np.uint64)
+    vals = keys * 3
+
+    def build(**kw):
+        mr = MapReduce(**kw)
+        mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+        mr.collapse(7)
+        return mr_groups(mr)
+
+    incore = build()
+    spilled = build(outofcore=1, memsize=1, maxpage=1, fpath=str(tmp_path))
+    assert list(incore) == list(spilled) == [7]
+    assert np.array_equal(np.asarray(incore[7]), np.asarray(spilled[7]))
+    # interleave order: k1,v1,k2,v2,...
+    flat = np.asarray(incore[7])
+    assert flat[0] == 0 and flat[1] == 0 and flat[2] == 1 and flat[3] == 3
