@@ -1,0 +1,118 @@
+"""Checkpoint / restore of MapReduce datasets.
+
+The reference has NO checkpointing: its out-of-core page files are
+deleted on destruction and persistence is limited to ``print``-to-file
+output that OINK re-parses as text (SURVEY.md §5 "checkpoint/resume:
+none").  This module is a deliberate capability improvement: a KV or
+KMV dataset round-trips losslessly (typed columns, byte strings,
+pickled objects, grouped frames) through a directory of ``.npz`` frame
+files plus a JSON manifest — frames stream one at a time in both
+directions, so saving or loading never materialises more than one
+frame beyond the normal budget.
+
+Script access: ``<MRname> save <dir>`` / ``<MRname> load <dir>``
+(oink/mrscript.py) — the script-level analogue of the reference's
+print-then-re-read idiom, without the text round-trip."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from .dataset import _col_from_npz, _col_to_npz
+from .frame import KMVFrame, KVFrame
+from .runtime import MRError
+
+_MANIFEST = "manifest.json"
+_VERSION = 1
+
+
+def save(mr, path: str) -> int:
+    """Write mr's dataset (KV or KMV) to directory ``path``; returns the
+    number of frames written.  Sharded frames are pulled to host (a
+    checkpoint must be readable without the mesh that produced it)."""
+    os.makedirs(path, exist_ok=True)
+    kind = "kv" if mr.kv is not None else ("kmv" if mr.kmv is not None
+                                           else "none")
+    nframes = 0
+    counts = []
+    if kind != "none":
+        ds = mr.kv if kind == "kv" else mr.kmv
+        if kind == "kv" and (ds._buf_k or ds._batches):
+            # an MR in the open() cross-add state has pairs only in its
+            # append buffers — frames() would silently omit them
+            raise MRError("cannot checkpoint an MR with uncompleted "
+                          "adds; close()/complete it first")
+        for fr in ds.frames():
+            fr = fr.to_host()
+            payload: dict = {}
+            if isinstance(fr, KVFrame):
+                _col_to_npz(fr.key, "k", payload)
+                _col_to_npz(fr.value, "v", payload)
+            elif isinstance(fr, KMVFrame):
+                _col_to_npz(fr.key, "k", payload)
+                _col_to_npz(fr.values, "v", payload)
+                payload["nvalues"] = np.asarray(fr.nvalues)
+                payload["offsets"] = np.asarray(fr.offsets)
+            else:  # pragma: no cover - defensive
+                raise MRError(f"cannot checkpoint frame type "
+                              f"{type(fr).__name__}")
+            np.savez(os.path.join(path, f"frame-{nframes:05d}.npz"),
+                     **payload)
+            counts.append(len(fr))
+            nframes += 1
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump({"version": _VERSION, "kind": kind, "nframes": nframes,
+                   "counts": counts}, f)
+    return nframes
+
+
+def load(mr, path: str) -> int:
+    """Replace mr's dataset with the checkpoint at ``path``; returns the
+    global pair/group count (like every mutating op)."""
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            man = json.load(f)
+    except FileNotFoundError:
+        raise MRError(f"no checkpoint manifest under {path!r}")
+    if man.get("version") != _VERSION:
+        raise MRError(f"unsupported checkpoint version {man.get('version')}")
+    kind = man["kind"]
+    if mr.kv is not None:
+        mr.kv.free()
+        mr.kv = None
+    if mr.kmv is not None:
+        mr.kmv.free()
+        mr.kmv = None
+    if kind == "none":
+        return 0
+    # frames restore ONE AT A TIME into the target's own budget:
+    # _push_frame/push spill immediately when the receiving MR runs
+    # outofcore, so a larger-than-RAM checkpoint restores without a
+    # consolidating merge (complete() is bypassed for exactly that
+    # reason on the KV path)
+    if kind == "kv":
+        ds = mr._new_kv()
+    else:
+        ds = mr._new_kmv()
+    for i in range(man["nframes"]):
+        with np.load(os.path.join(path, f"frame-{i:05d}.npz"),
+                     allow_pickle=False) as z:
+            if kind == "kv":
+                ds._push_frame(KVFrame(_col_from_npz(z, "k"),
+                                       _col_from_npz(z, "v")))
+            else:
+                ds.push(KMVFrame(_col_from_npz(z, "k"), z["nvalues"],
+                                 z["offsets"], _col_from_npz(z, "v")))
+    if kind == "kv":
+        mr.kv = ds
+        ds.nkv = sum(ds._frame_n(f) for f in ds._frames)
+        ds.complete_done = True
+        n = ds.nkv
+    else:
+        mr.kmv = ds
+        n = ds.complete()
+    return int(mr.backend.allreduce_sum(n))

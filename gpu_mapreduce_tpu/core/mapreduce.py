@@ -781,6 +781,22 @@ class MapReduce:
                 write_histo("KMV groups", self._shard_counts("kmv"))
         return (g, n, nb)
 
+    # ------------------------------------------------------------------
+    # checkpoint / restore (capability improvement over the reference,
+    # which persists only via print-to-file text — SURVEY.md §5)
+    # ------------------------------------------------------------------
+    def save(self, path: str) -> int:
+        """Checkpoint the current KV or KMV to a directory; returns the
+        number of frames written (core/checkpoint.py)."""
+        from .checkpoint import save as _save
+        return _save(self, path)
+
+    def load(self, path: str) -> int:
+        """Replace the dataset with a checkpoint; returns the global
+        pair/group count."""
+        from .checkpoint import load as _load
+        return _load(self, path)
+
     def cummulative_stats(self, level: int = 1, reset: int = 0):
         c = self.counters
         if level:
@@ -839,9 +855,11 @@ def _interleave_frame(fr: KVFrame, error: Error) -> Column:
         return BytesColumn(out)
     if isinstance(k, DenseColumn) and isinstance(v, DenseColumn):
         ka, va = np.asarray(k.data), np.asarray(v.data)
-        if ka.shape[1:] == va.shape[1:]:
-            dt = np.promote_types(ka.dtype, va.dtype)
-            arr = np.empty((2 * n,) + ka.shape[1:], dt)
+        # fast path only for IDENTICAL dtypes: numpy "promotes"
+        # uint64+int64 to float64, which would silently round u64 hash
+        # ids above 2^53 — mixed dtypes take the exact per-row path
+        if ka.shape[1:] == va.shape[1:] and ka.dtype == va.dtype:
+            arr = np.empty((2 * n,) + ka.shape[1:], ka.dtype)
             arr[0::2] = ka
             arr[1::2] = va
             return DenseColumn(arr)

@@ -179,6 +179,19 @@ class MRScriptDispatch:
     def m_scan_kmv(self, name, mr, a):
         mr.print()
 
+    def m_save(self, name, mr, a):
+        """save <dir> — checkpoint the dataset (capability improvement;
+        the reference persists only via print-to-file text)."""
+        if len(a) != 1:
+            raise MRError("Illegal MR object save command")
+        mr.save(a[0])
+
+    def m_load(self, name, mr, a):
+        """load <dir> — restore a checkpointed dataset."""
+        if len(a) != 1:
+            raise MRError("Illegal MR object load command")
+        mr.load(a[0])
+
     def m_print(self, name, mr, a):
         """print [proc nstride kflag vflag] (reference mrmpi.cpp print
         case; proc selects which rank prints — single controller here, so
