@@ -626,7 +626,10 @@ class MapReduce:
             return self._sort_kv_external(kv, by, flag_or_cmp < 0, t)
         fr = kv.one_frame()
         if not isinstance(fr, KVFrame):
-            if not callable(flag_or_cmp):  # per-shard device sort
+            interned = by == "key" and \
+                getattr(fr, "key_decode", None) is not None
+            if not callable(flag_or_cmp) and not interned:
+                # per-shard device sort
                 from ..parallel.group import sort_sharded
                 out = sort_sharded(fr, by, descending=flag_or_cmp < 0)
                 kv.free()
@@ -635,7 +638,11 @@ class MapReduce:
                 self._op_stats(f"sort_{by}s", nkv=n)
                 self._time("sort", t)
                 return int(self.backend.allreduce_sum(n))
-            fr = fr.to_host()  # comparator callbacks serialize to host
+            # comparator callbacks serialize to host; interned byte keys
+            # ALSO decode to host first — their u64 ids are hashes, so a
+            # device sort over ids would not be lexicographic (reference
+            # flag 5/6 string semantics, src/mapreduce.cpp:2763-2802)
+            fr = fr.to_host()
         col = fr.key if by == "key" else fr.value
         if callable(flag_or_cmp):
             order = argsort_column(col, cmp=flag_or_cmp)

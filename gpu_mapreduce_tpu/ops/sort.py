@@ -3,10 +3,10 @@
 The reference sorts per-process with an index-array qsort over one page and a
 Spool-based merge cascade across pages (``src/mapreduce.cpp:2359-2633``).  On
 TPU a whole shard sorts in one ``jax.lax.sort`` call (XLA's bitonic sort runs
-on the VPU), so the merge machinery disappears.  NOTE: sorting/convert
-currently consolidate the dataset in core (``KeyValue.one_frame``) — spilled
-frames are reloaded for the op; a streaming k-way merge over pre-sorted host
-frames is the planned out-of-core path (SURVEY.md §7 step 5).
+on the VPU), so the merge machinery disappears for in-core/device data.
+Out-of-core datasets take the streaming path instead: per-frame sorted runs
++ k-way merge in ~one page budget (``core/external.py`` — the Spool
+cascade's capability, rebuilt).
 
 Sort "flags" ±1..6 select the pre-built comparators in the reference
 (int/uint64/float/double/str/strn, ``src/mapreduce.cpp:2692-2802``).  Columns

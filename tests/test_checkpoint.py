@@ -137,3 +137,14 @@ def test_collapse_mixed_dtype_stays_exact():
     mr.scan_kmv(lambda k, vs, p: groups.__setitem__(k, list(vs)))
     assert groups[0][0] == big
     assert groups[0][1] == -1
+
+
+def test_example_in_checkpoint(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    from gpu_mapreduce_tpu.oink.script import OinkScript
+
+    s = OinkScript(screen=False, logfile=None)
+    s.run_file("/root/repo/examples/in.checkpoint")
+    a = sorted((tmp_path / "deg.original").read_text().split())
+    b = sorted((tmp_path / "deg.restored").read_text().split())
+    assert a == b and len(a) > 0

@@ -251,3 +251,25 @@ def test_build_send_round_window_no_wrap():
                 if q0 < counts[d]:
                     expect[d, s] = rows[offs[d] + q0]
         np.testing.assert_array_equal(send, expect, err_msg=f"round {r}")
+
+
+def test_sort_keys_lexicographic_after_intern():
+    """sort_keys on a mesh KV whose byte keys were auto-interned must
+    order by the BYTES, not the u64 intern ids (reference string sort,
+    src/mapreduce.cpp:2763-2802)."""
+    from gpu_mapreduce_tpu import MapReduce
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    words = [b"pear", b"apple", b"fig", b"zoo", b"beta", b"kiwi",
+             b"mango", b"date"]
+    mr = MapReduce(make_mesh(4))
+    mr.map(1, lambda i, kv, p: [kv.add(w, 1) for w in words])
+    mr.aggregate()
+    mr.sort_keys(5)
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append(k))
+    assert got == sorted(words)
+    mr.sort_keys(-5)
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append(k))
+    assert got == sorted(words, reverse=True)
