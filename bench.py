@@ -168,14 +168,30 @@ def main():
             # so a real — if unflattering — number is still recorded
             # alongside the error.
             pin_platform("cpu")
-            engine = "native"
+            engines = ["native"]
+        elif is_tpu_backend(platform):
+            # a Mosaic rejection of the Pallas kernel must not cost the
+            # round's number: fall through to the pure-XLA device path,
+            # then the host C++ tier
+            engines = ["pallas", "xla", "native"]
         else:
-            engine = "pallas" if is_tpu_backend(platform) else "native"
-        if engine == "native":
-            from gpu_mapreduce_tpu import native
-            if not native.available():
-                engine = "xla"  # no C++ toolchain: interpret path still runs
-        run_bench(engine, backend_err)
+            engines = ["native"]
+        from gpu_mapreduce_tpu import native
+        if not native.available():
+            engines = [e for e in engines if e != "native"] or ["xla"]
+        last = None
+        for i, engine in enumerate(engines):
+            try:
+                run_bench(engine, backend_err)
+                return
+            except BaseException:
+                last = traceback.format_exc().strip().splitlines()
+                note = f"engine {engine} failed: " + \
+                    " | ".join(last[-2:])[-300:]
+                backend_err = (backend_err + " | " + note) if backend_err \
+                    else note
+                print(json.dumps({"fallback": note}), file=sys.stderr)
+        raise RuntimeError(backend_err or "all engines failed")
     except BaseException:
         tb = traceback.format_exc().strip().splitlines()
         err = ((backend_err + " | ") if backend_err else "") + \
