@@ -254,6 +254,46 @@ def test_example_in_luby_golden(tmp_path, monkeypatch):
     assert "Luby_find: 1123 MIS vertices in 5 iterations" in text
 
 
+def test_example_in_tri_golden(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.run_file("/root/repo/examples/in.tri")
+    text = out.getvalue()
+    assert "RMAT: 65536 rows, 524288 non-zeroes" in text
+    assert "Tri_find: 670 triangles" in text
+    rows = (tmp_path / "tmp.tri").read_text().splitlines()
+    assert len(rows) == 670
+
+
+def test_example_in_pagerank_golden(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.run_file("/root/repo/examples/in.pagerank")
+    text = out.getvalue()
+    assert "RMAT: 16384 rows, 131072 non-zeroes" in text
+    assert "PageRank: 11227 vertices, 131072 edges, 7 iterations" in text
+    import numpy as np
+    ranks = np.loadtxt(tmp_path / "tmp.pr", dtype=np.float64)
+    assert len(ranks) == 11227
+    assert abs(ranks[:, 1].sum() - 1.0) < 1e-3      # a distribution
+
+
+def test_example_in_wordfreq_via_var(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    corpus = tmp_path / "data.txt"
+    corpus.write_text("to be or not to be that is the question "
+                      "to be is to do")
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.variables.set(["files", "index", str(corpus)])
+    s.run_file("/root/repo/examples/in.wordfreq")
+    text = out.getvalue()
+    assert "1 files, 15 words, 9 unique" in text
+    assert "4 to" in text and "3 be" in text
+
+
 def test_example_in_sssp_named_mr_weighting(tmp_path, monkeypatch):
     # in.sssp drives `mre map/mr mre add_weight` through named-MR dispatch
     monkeypatch.chdir(tmp_path)
