@@ -473,3 +473,40 @@ def test_collapse_spilled_multiframe(tmp_path):
     # interleave order: k1,v1,k2,v2,...
     flat = np.asarray(incore[7])
     assert flat[0] == 0 and flat[1] == 0 and flat[2] == 1 and flat[3] == 3
+
+
+def test_map_file_str_multichar_separator(tmp_path):
+    """map_file_str splits on a multi-byte separator; chunk concat must
+    equal the file exactly (reference map_chunks sepstr variant,
+    src/mapreduce.cpp:1312-1469)."""
+    recs = b"".join(b"record %04d<END>" % i for i in range(500))
+    p = tmp_path / "recs.dat"
+    p.write_bytes(recs)
+    mr = MapReduce()
+    chunks = []
+
+    def per_chunk(itask, chunk, kv, ptr):
+        chunks.append(chunk)
+        kv.add(itask, len(chunk))
+
+    n = mr.map_file_str(8, str(p), 0, 0, "<END>", 64, per_chunk)
+    assert n >= 2                       # actually split
+    assert b"".join(chunks) == recs
+    for c in chunks[:-1]:
+        assert c.endswith(b"<END>")     # splits land on the separator
+
+
+def test_cummulative_stats_counters(tmp_path, capsys):
+    """cummulative_stats reports spill read/write volume (reference
+    static counters, src/mapreduce.h:46-57 / mapreduce.cpp:3007-3066)."""
+    from gpu_mapreduce_tpu.core.runtime import global_counters
+
+    before_w = global_counters().wsize
+    mr = MapReduce(outofcore=1, memsize=1, maxpage=1, fpath=str(tmp_path))
+    keys = np.arange(300_000, dtype=np.uint64)
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, keys))
+    mr.convert()
+    assert global_counters().wsize > before_w      # spill happened
+    mr.cummulative_stats(1)
+    out = capsys.readouterr().out
+    assert "Mb" in out or "bytes" in out or out    # prints a report
