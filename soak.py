@@ -59,18 +59,25 @@ def main():
     mesh = make_mesh(1)
 
     # -- degree (edges → collate → count), device tier -----------------
-    mr = MapReduce(mesh)
+    # run twice at full shape: the first pass pays the XLA compiles
+    # (bench.py warms the same way); the recorded number is steady state
     e64 = edges.astype(np.uint64)
-    mr.map(1, lambda i, kv, p: kv.add_batch(
-        e64, np.zeros(len(e64), np.uint8)))
-    t0 = time.perf_counter()
-    mr.map_mr(mr, edge_to_vertices, batch=True)
-    mr.collate()
-    ndeg = mr.reduce(count, batch=True)
-    dt = time.perf_counter() - t0
+
+    def run_degree():
+        mr = MapReduce(mesh)
+        mr.map(1, lambda i, kv, p: kv.add_batch(
+            e64, np.zeros(len(e64), np.uint8)))
+        t0 = time.perf_counter()
+        mr.map_mr(mr, edge_to_vertices, batch=True)
+        mr.collate()
+        ndeg = mr.reduce(count, batch=True)
+        return ndeg, time.perf_counter() - t0
+
+    run_degree()
+    ndeg, dt = run_degree()
     published["degree_edges_per_sec"] = round(nedges / dt, 1)
     print(f"degree: {ndeg} vertices, {dt:.2f}s -> "
-          f"{nedges / dt:,.0f} edges/s")
+          f"{nedges / dt:,.0f} edges/s (warm)")
 
     # -- cc_find (full OINK command, device-resident loop) -------------
     import tempfile
@@ -79,6 +86,8 @@ def main():
         sub = edges[: min(len(edges), 1 << (scale - 1))]
         sub = sub[sub[:, 0] != sub[:, 1]]
         np.savetxt(path, sub, fmt="%d")
+        run_command("cc_find", ["0"], obj=ObjectManager(comm=mesh),
+                    inputs=[path], screen=False)   # warm the compile
         obj = ObjectManager(comm=mesh)
         t0 = time.perf_counter()
         cmd = run_command("cc_find", ["0"], obj=obj, inputs=[path],
@@ -97,6 +106,7 @@ def main():
     dstv = edges[:, 1].astype(np.int32)
     w = np.random.default_rng(7).uniform(0.5, 5.0, len(edges))
     bf = prepare_bellman_ford(mesh, srcv, dstv, w, nv)  # pad+upload once
+    bf(0)                                               # warm the compile
     t0 = time.perf_counter()
     titers = 0
     for s in (0, 1, 2, 3):
@@ -116,6 +126,7 @@ def main():
     ldst = uinv.reshape(-1, 2)[:, 1]
     keep = lsrc != ldst
     prio = vertex_rand(uverts, 99)
+    luby_mis_sharded(mesh, lsrc[keep], ldst[keep], prio, len(uverts))
     t0 = time.perf_counter()
     state, lit = luby_mis_sharded(mesh, lsrc[keep], ldst[keep], prio,
                                   len(uverts))
@@ -130,6 +141,7 @@ def main():
     n = 1 << scale
     src = edges[:, 0].astype(np.int32)
     dst = edges[:, 1].astype(np.int32)
+    pagerank_sharded(mesh, src, dst, n, tol=1e-6, maxiter=20)  # warm
     t0 = time.perf_counter()
     ranks, niter = pagerank_sharded(mesh, src, dst, n, tol=1e-6, maxiter=20)
     dt = time.perf_counter() - t0
