@@ -17,8 +17,10 @@ from gpu_mapreduce_tpu.ops.hash import hash_u64
 
 @pytest.fixture(scope="module")
 def mesh():
-    assert len(jax.devices()) == 8, "conftest should fake 8 CPU devices"
-    return make_mesh()
+    # conftest fakes 8 CPU devices; a larger fake cluster (pod-scale
+    # sanity runs override the flag) still exercises the same paths
+    assert len(jax.devices()) >= 8, "conftest should fake >=8 CPU devices"
+    return make_mesh(8)
 
 
 def emit(itask, kv, ptr):
