@@ -280,6 +280,16 @@ def test_example_in_pagerank_golden(tmp_path, monkeypatch):
     assert abs(ranks[:, 1].sum() - 1.0) < 1e-3      # a distribution
 
 
+def test_example_in_rmat_golden(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    out = io.StringIO()
+    s = OinkScript(screen=out)
+    s.run_file("/root/repo/examples/in.rmat")
+    text = out.getvalue()
+    assert "RMAT: 65536 rows, 524288 non-zeroes" in text
+    assert "DegreeStats: 65536 vertices, 524288 edges" in text
+
+
 def test_example_in_wordfreq_via_var(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     corpus = tmp_path / "data.txt"
