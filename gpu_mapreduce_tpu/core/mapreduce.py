@@ -694,15 +694,7 @@ class MapReduce:
                     new.push(sort_multivalues_sharded(
                         fr, descending=flag_or_cmp < 0))
                     continue
-            pieces = []
-            for i in range(len(fr)):
-                col = fr.group_values(i)
-                if callable(flag_or_cmp):
-                    order = argsort_column(col, cmp=flag_or_cmp)
-                else:
-                    order = argsort_column(col, descending=flag_or_cmp < 0)
-                pieces.append(col.take(order))
-            values = concat(pieces) if pieces else fr.values
+            values = _sort_groups(fr, flag_or_cmp)
             new.push(KMVFrame(fr.key, fr.nvalues, fr.offsets, values))
         kmv.free()
         self.kmv = new
@@ -846,6 +838,35 @@ def _rows_to_column(rows: list) -> Column:
                             for r in rows])
     from .dataset import rows_to_array
     return DenseColumn(rows_to_array(rows))
+
+
+def _sort_groups(fr: KMVFrame, flag_or_cmp) -> Column:
+    """Sort the values inside every group of a host KMVFrame.  Dense
+    scalar values sort in ONE stable lexsort over (group, value) — no
+    per-group Python; comparator callbacks and non-scalar values keep
+    the per-group path."""
+    if not callable(flag_or_cmp) and isinstance(fr.values, DenseColumn):
+        vals = np.asarray(fr.values.data)
+        if vals.ndim == 1:
+            seg = np.repeat(np.arange(len(fr), dtype=np.int64),
+                            np.asarray(fr.nvalues, dtype=np.int64))
+            order = np.lexsort((vals, seg))     # ascending within groups
+            if flag_or_cmp < 0:
+                # descending: reverse each group's slice of the
+                # ascending order (offsets arithmetic, still no loop)
+                off = np.asarray(fr.offsets)
+                pos = np.arange(len(vals), dtype=np.int64)
+                order = order[off[seg] + off[seg + 1] - 1 - pos]
+            return DenseColumn(vals[order])
+    pieces = []
+    for i in range(len(fr)):
+        col = fr.group_values(i)
+        if callable(flag_or_cmp):
+            order = argsort_column(col, cmp=flag_or_cmp)
+        else:
+            order = argsort_column(col, descending=flag_or_cmp < 0)
+        pieces.append(col.take(order))
+    return concat(pieces) if pieces else fr.values
 
 
 def _interleave_frame(fr: KVFrame, error: Error) -> Column:
