@@ -68,9 +68,19 @@ def main():
             rows.append({"nprocs": P, "nunique": int(n),
                          **{k: round(v, 3) for k, v in stages.items()}})
             print(json.dumps(rows[-1]))
-    print(json.dumps({"weak_scaling": rows,
-                      "mb_per_proc": mb_per_proc,
-                      "backend": jax.default_backend()}))
+    record = {"weak_scaling": rows, "mb_per_proc": mb_per_proc,
+              "backend": jax.default_backend()}
+    print(json.dumps(record))
+    # persist like soak.py: backend-qualified, never clobbering others
+    try:
+        with open("BASELINE.json") as f:
+            base = json.load(f)
+        base.setdefault("published", {})[
+            f"weakscale_{record['backend']}"] = record
+        with open("BASELINE.json", "w") as f:
+            json.dump(base, f, indent=2)
+    except FileNotFoundError:
+        pass
 
 
 if __name__ == "__main__":
