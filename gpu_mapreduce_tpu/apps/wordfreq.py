@@ -60,19 +60,36 @@ def wordfreq_interned(files: Sequence[str], ntop: int = 10, comm=None
 
     from ..ops.segment import kmv_segment_ids, segment_reduce
 
+    from .. import native
+
     mr = MapReduce(comm)
     vocab = {}
 
+    def _guard(h, w):
+        prev = vocab.get(h)
+        if prev is not None and prev != w:
+            raise ValueError(
+                "64-bit intern collision between %r and %r" % (prev, w))
+        vocab[h] = w
+
     def fileread_ids(itask, filename, kv, ptr):
         with open(filename, "rb") as f:
-            words = read_words(f.read())
-        col, table = BytesColumn(words).intern()
+            raw = f.read()
+        if native.available():
+            # zero per-token Python: C++ tokenizer + in-place range
+            # interning; only each file's UNIQUE words slice out for the
+            # vocab (the decode dict for the top-N output)
+            data = np.frombuffer(raw, np.uint8)
+            starts, lens = native.tokenize(data)
+            ids = native.intern_ranges(data, starts, lens)
+            uniq, first = np.unique(ids, return_index=True)
+            for h, fi in zip(uniq.tolist(), first.tolist()):
+                _guard(h, raw[starts[fi]:starts[fi] + lens[fi]])
+            kv.add_batch(ids, np.ones(len(ids), np.int64))
+            return
+        col, table = BytesColumn(read_words(raw)).intern()
         for h, w in table.items():  # cross-file collision guard
-            prev = vocab.get(h)
-            if prev is not None and prev != w:
-                raise ValueError(
-                    "64-bit intern collision between %r and %r" % (prev, w))
-            vocab[h] = w
+            _guard(h, w)
         kv.add_batch(col, np.ones(len(col.data), np.int64))
 
     nwords = mr.map_files(list(files), fileread_ids)

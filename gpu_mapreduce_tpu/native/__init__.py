@@ -77,6 +77,8 @@ def _load() -> Optional[ctypes.CDLL]:
                                    p(ctypes.c_void_p), i64]
     lib.mr_find_hrefs.restype = i64
     lib.mr_find_hrefs.argtypes = [u8p, i64, p(i64), p(i64), i64]
+    lib.mr_tokenize.restype = i64
+    lib.mr_tokenize.argtypes = [u8p, i64, p(i64), p(i64), i64]
     return lib
 
 
@@ -180,6 +182,26 @@ def find_hrefs(buf) -> Tuple[np.ndarray, np.ndarray]:
         n = _lib.mr_find_hrefs(ptr, len(buf),
                                _arr(starts, ctypes.c_int64),
                                _arr(lens, ctypes.c_int64), cap)
+        if n >= 0:
+            return starts[:n], lens[:n]
+        cap = -n
+
+
+def tokenize(buf) -> Tuple[np.ndarray, np.ndarray]:
+    """(starts, lens) of every whitespace-separated token — the host
+    tokenizer behind wordfreq/read_words ingestion (pairs with
+    intern_ranges for zero-per-token-Python word ids)."""
+    if isinstance(buf, np.ndarray):
+        ptr = _arr(np.ascontiguousarray(buf, np.uint8), ctypes.c_uint8)
+    else:
+        ptr = _u8(buf)
+    cap = max(16, len(buf) // 4)
+    while True:
+        starts = np.empty(cap, np.int64)
+        lens = np.empty(cap, np.int64)
+        n = _lib.mr_tokenize(ptr, len(buf),
+                             _arr(starts, ctypes.c_int64),
+                             _arr(lens, ctypes.c_int64), cap)
         if n >= 0:
             return starts[:n], lens[:n]
         cap = -n

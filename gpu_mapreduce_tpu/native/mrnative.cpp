@@ -194,6 +194,25 @@ int64_t mr_parse_table(const uint8_t *buf, int64_t len, int64_t ncols,
   return rows <= maxrows ? rows : -rows;
 }
 
+// whitespace tokenizer — (start, len) of every token, the host hot path
+// of the wordfreq/read_words ingestion (oink/map_read_words.cpp splits
+// per word in its callback; doing it here removes the per-token Python
+// object churn when paired with mr_intern_ranges).  Same whitespace set
+// as is_space/bytes.split.  Returns count or -needed.
+int64_t mr_tokenize(const uint8_t *buf, int64_t len, int64_t *starts,
+                    int64_t *lens, int64_t max) {
+  int64_t n = 0, i = 0;
+  while (i < len) {
+    while (i < len && is_space(buf[i])) i++;
+    if (i >= len) break;
+    int64_t s = i;
+    while (i < len && !is_space(buf[i])) i++;
+    if (n < max) { starts[n] = s; lens[n] = i - s; }
+    n++;
+  }
+  return n <= max ? n : -n;
+}
+
 // href-URL extraction — the host equivalent of the CUDA mark /
 // compute_url_length kernels (cuda/InvertedIndex.cu:79-135) and the CPU
 // FSM parser (cpu/InvertedIndex.cpp:144-265): find every `<a href="`,
