@@ -340,7 +340,11 @@ class InvertedIndex:
                                         "host_add": "map_kernels"})
         self._intern_lock = threading.Lock()
         self._keep_bytes = True
-        self._id_check: List[tuple] = []   # (ids, alts) when dict skipped
+        # geometric sorted runs of unique (id, alt-id) pairs when the
+        # url dict is skipped — folded per batch so host memory stays
+        # bounded by the UNIQUE url count on exactly the large-corpus
+        # path (ADVICE r2); see _fold_id_check
+        self._chk_runs: List[tuple] = []
 
     # -- map stage: native (host C++) tier --------------------------------
     # device alt-id seed family (see _extract_build): the host twin uses
@@ -375,12 +379,49 @@ class InvertedIndex:
                     self._intern(ids, urls)
             else:
                 # no url dict (URL_DICT_MAX policy, like the device
-                # tier): record an independent alt-id family instead so
-                # run() can still detect u64 intern collisions
-                self._id_check.append(
-                    (ids, native.intern_ranges(data, kst, kln,
-                                               self._ALT_HI, self._ALT_LO)))
+                # tier): fold an independent alt-id family into the
+                # running unique set so u64 intern collisions are still
+                # detected without holding per-file arrays
+                alts = native.intern_ranges(data, kst, kln,
+                                            self._ALT_HI, self._ALT_LO)
+                with self._intern_lock:
+                    self._fold_id_check(ids, alts)
             kv.add_batch(ids, np.full(len(ids), doc_id, dtype=np.uint32))
+
+    def _fold_id_check(self, ids, alts):
+        """Merge a batch of (id, alt) pairs into the running check set;
+        a collision is one id carrying two alt values.  The set is kept
+        as geometric sorted runs (LSM-style): a batch probes every run
+        with searchsorted, entries already present are dropped (runs
+        stay id-disjoint), then the batch becomes a new run and
+        similar-sized runs merge — amortised O(N log F) total instead of
+        rebuilding one array per file.  Caller holds ``_intern_lock``
+        under the mapstyle-2 threads."""
+        order = np.lexsort((alts, ids))
+        bi, ba = ids[order], alts[order]
+        keep = np.ones(len(bi), bool)
+        keep[1:] = (bi[1:] != bi[:-1]) | (ba[1:] != ba[:-1])
+        bi, ba = bi[keep], ba[keep]          # exact-duplicate pairs ok
+        if (bi[1:] == bi[:-1]).any():        # same id, two alts in batch
+            raise ValueError("64-bit URL intern collision(s) detected")
+        for ri, ra in self._chk_runs:
+            pos = np.searchsorted(ri, bi)
+            safe = np.minimum(pos, len(ri) - 1)
+            hit = (pos < len(ri)) & (ri[safe] == bi)
+            if (hit & (ra[safe] != ba)).any():
+                raise ValueError("64-bit URL intern collision(s) detected")
+            bi, ba = bi[~hit], ba[~hit]
+        if len(bi):
+            self._chk_runs.append((bi, ba))
+            while (len(self._chk_runs) >= 2 and
+                   len(self._chk_runs[-2][0]) <
+                   2 * len(self._chk_runs[-1][0])):
+                yi, ya = self._chk_runs.pop()
+                xi, xa = self._chk_runs.pop()
+                mi = np.concatenate([xi, yi])
+                ma = np.concatenate([xa, ya])
+                o = np.argsort(mi, kind="stable")
+                self._chk_runs.append((mi[o], ma[o]))
 
     def _intern(self, ids, urls):
         for h, url in zip(ids.tolist(), urls):
@@ -521,16 +562,10 @@ class InvertedIndex:
                 self.docs = list(files)
                 self._keep_bytes = _url_dict_wanted(files,
                                                     outdir is not None)
-                self._id_check = []
+                self._chk_runs = []
+                # collisions surface inside _fold_id_check as files map
                 self.npairs = mr.map_files(files, self._map_file_native)
-                if self._id_check:
-                    ncoll = _host_collision_count(
-                        np.concatenate([c[0] for c in self._id_check]),
-                        np.concatenate([c[1] for c in self._id_check]))
-                    if ncoll:
-                        raise ValueError(f"{ncoll} 64-bit URL intern "
-                                         f"collision(s) detected")
-                    self._id_check = []
+                self._chk_runs = []
             else:
                 self.npairs = mr.map(
                     1, lambda itask, kv, ptr: self._map_corpus_device(

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Optional
 
 import numpy as np
@@ -33,40 +34,83 @@ _VERSION = 1
 def save(mr, path: str) -> int:
     """Write mr's dataset (KV or KMV) to directory ``path``; returns the
     number of frames written.  Sharded frames are pulled to host (a
-    checkpoint must be readable without the mesh that produced it)."""
-    os.makedirs(path, exist_ok=True)
+    checkpoint must be readable without the mesh that produced it).
+
+    The save is atomic at directory granularity: frames + manifest are
+    written to a temp sibling and swapped into place with rename, so an
+    interrupted save can never leave a loadable manifest pointing at a
+    mix of old and new frames (a prior in-place overwrite could)."""
+    path = os.path.normpath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
     kind = "kv" if mr.kv is not None else ("kmv" if mr.kmv is not None
                                            else "none")
     nframes = 0
     counts = []
-    if kind != "none":
-        ds = mr.kv if kind == "kv" else mr.kmv
-        if kind == "kv" and (ds._buf_k or ds._batches):
-            # an MR in the open() cross-add state has pairs only in its
-            # append buffers — frames() would silently omit them
-            raise MRError("cannot checkpoint an MR with uncompleted "
-                          "adds; close()/complete it first")
-        for fr in ds.frames():
-            fr = fr.to_host()
-            payload: dict = {}
-            if isinstance(fr, KVFrame):
-                _col_to_npz(fr.key, "k", payload)
-                _col_to_npz(fr.value, "v", payload)
-            elif isinstance(fr, KMVFrame):
-                _col_to_npz(fr.key, "k", payload)
-                _col_to_npz(fr.values, "v", payload)
-                payload["nvalues"] = np.asarray(fr.nvalues)
-                payload["offsets"] = np.asarray(fr.offsets)
-            else:  # pragma: no cover - defensive
-                raise MRError(f"cannot checkpoint frame type "
-                              f"{type(fr).__name__}")
-            np.savez(os.path.join(path, f"frame-{nframes:05d}.npz"),
-                     **payload)
-            counts.append(len(fr))
-            nframes += 1
-    with open(os.path.join(path, _MANIFEST), "w") as f:
-        json.dump({"version": _VERSION, "kind": kind, "nframes": nframes,
-                   "counts": counts}, f)
+    try:
+        if kind != "none":
+            ds = mr.kv if kind == "kv" else mr.kmv
+            if kind == "kv" and (ds._buf_k or ds._batches):
+                # an MR in the open() cross-add state has pairs only in
+                # its append buffers — frames() would silently omit them
+                raise MRError("cannot checkpoint an MR with uncompleted "
+                              "adds; close()/complete it first")
+            for fr in ds.frames():
+                fr = fr.to_host()
+                payload: dict = {}
+                if isinstance(fr, KVFrame):
+                    _col_to_npz(fr.key, "k", payload)
+                    _col_to_npz(fr.value, "v", payload)
+                elif isinstance(fr, KMVFrame):
+                    _col_to_npz(fr.key, "k", payload)
+                    _col_to_npz(fr.values, "v", payload)
+                    payload["nvalues"] = np.asarray(fr.nvalues)
+                    payload["offsets"] = np.asarray(fr.offsets)
+                else:  # pragma: no cover - defensive
+                    raise MRError(f"cannot checkpoint frame type "
+                                  f"{type(fr).__name__}")
+                np.savez(os.path.join(tmp, f"frame-{nframes:05d}.npz"),
+                         **payload)
+                counts.append(len(fr))
+                nframes += 1
+        with open(os.path.join(tmp, _MANIFEST), "w") as f:
+            json.dump({"version": _VERSION, "kind": kind,
+                       "nframes": nframes, "counts": counts}, f)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    # swap: worst case after a crash is a MISSING checkpoint (old dir
+    # renamed aside), never a manifest over mixed-generation frames
+    try:
+        if os.path.exists(path):
+            if not os.path.isdir(path):
+                raise MRError(f"checkpoint target {path!r} exists and is "
+                              f"not a directory")
+            foreign = [f for f in os.listdir(path)
+                       if f != _MANIFEST and not f.startswith("frame-")]
+            if foreign:
+                raise MRError(
+                    f"checkpoint target {path!r} holds non-checkpoint "
+                    f"files {foreign[:3]!r}; refusing to replace the "
+                    f"directory")
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    old = f"{path}.old.{os.getpid()}"
+    shutil.rmtree(old, ignore_errors=True)
+    try:
+        if os.path.exists(path):
+            os.rename(path, old)
+        try:
+            os.rename(tmp, path)
+        except BaseException:
+            if not os.path.exists(path) and os.path.exists(old):
+                os.rename(old, path)   # put the previous checkpoint back
+            raise
+    finally:
+        shutil.rmtree(old, ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
     return nframes
 
 

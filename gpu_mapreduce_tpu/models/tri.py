@@ -70,7 +70,8 @@ def triangles(edges: np.ndarray, use_device: Optional[bool] = None
 
     deg = np.bincount(inv, minlength=n)
     # orient a→b from the smaller (degree, id); rank = deg*n + id is a
-    # total order and fits u64 for any n < 2^32
+    # total order and fits u64 for any n < 2^32 (same guard as rmat.py)
+    assert n < 2**32, f"triangles(): {n} vertices overflow u64 rank packing"
     rank = deg.astype(np.uint64) * np.uint64(n) + np.arange(n, dtype=np.uint64)
     swap = rank[a] > rank[b]
     lo = np.where(swap, b, a)

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Watch the flaky axon TPU tunnel; the moment it answers, capture the
+# round's real-TPU records (VERDICT r2 #1b):
+#   * bench.py  -> /tmp/bench_tpu.out   (stdout JSON metric line)
+#   * soak.py   -> BASELINE.json published.soak_<backend> (fused engines)
+# The tunnel hangs rather than errors when down (see utils/platform.py),
+# so every probe and run sits under a hard timeout.  The watcher only
+# stops once BOTH captures really ran on a TPU backend — a mid-run
+# tunnel drop (bench falls back to CPU, or timeout kills it) loops back
+# to probing instead of declaring victory.
+cd /root/repo || exit 1
+LOG=/tmp/tpu_watch.log
+BENCH_OK=0
+SOAK_OK=0
+while true; do
+  if timeout 240 python -c "import jax; b = jax.default_backend(); assert b in ('tpu', 'axon'), b" 2>>"$LOG"; then
+    echo "$(date -u +%FT%TZ) tunnel UP — capturing bench + soak" >>"$LOG"
+    if [ "$BENCH_OK" = 0 ]; then
+      BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=2 \
+        timeout 5400 python bench.py >/tmp/bench_tpu.out 2>/tmp/bench_tpu.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) bench rc=$rc $(cat /tmp/bench_tpu.out)" >>"$LOG"
+      if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu.out; then
+        BENCH_OK=1
+        cp /tmp/bench_tpu.out /tmp/bench_tpu.captured
+      fi
+    fi
+    if [ "$SOAK_OK" = 0 ]; then
+      SOAK_SCALE="${SOAK_SCALE:-20}" \
+        timeout 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$LOG"
+      if [ $rc -eq 0 ] && grep -Eq 'soak_(tpu|axon)' BASELINE.json; then
+        SOAK_OK=1
+      fi
+    fi
+    if [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ]; then
+      touch /tmp/tpu_captured.flag
+      echo "$(date -u +%FT%TZ) both records captured on TPU" >>"$LOG"
+      exit 0
+    fi
+  fi
+  echo "$(date -u +%FT%TZ) tunnel down or capture incomplete (bench=$BENCH_OK soak=$SOAK_OK)" >>"$LOG"
+  sleep 240
+done
