@@ -119,7 +119,12 @@ def _extract_wide_fn(cap: int, use_pallas: bool, interpret: bool):
     return _extract_build(cap, use_pallas, interpret, wide=True)
 
 
-def _extract_build(cap: int, use_pallas: bool, interpret: bool, wide: bool):
+def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
+                  interpret: bool, wide: bool):
+    """The fused map-stage computation over ONE shard's corpus words.
+    Shared by the single-device jit (_extract_build) and the mesh SPMD
+    program (_extract_mesh_fn) — identical math, so the tiers and the
+    mesh shards produce bit-identical ids."""
     bs = min(_BS, cap)
     nw = MAX_URL // 4
     w1 = nw if wide else _W_SHORT
@@ -134,62 +139,95 @@ def _extract_build(cap: int, use_pallas: bool, interpret: bool, wide: bool):
         alt = hash_bytes64_masked(wm, l0, 0x9E3779B9, 0x85EBCA6B)
         return ids, alt
 
-    @jax.jit
-    def run(words, file_starts):
-        m = words.shape[0]
-        nbytes = 4 * m
-        wmask = (mark_words_pallas(words, PATTERN, interpret=interpret)
-                 if use_pallas else mark_words_xla(words, PATTERN))
-        starts, nhits = compact_word_matches(wmask, nbytes, cap)
-        ustarts = starts + np.int32(len(PATTERN))
+    m = words.shape[0]
+    nbytes = 4 * m
+    wmask = (mark_words_pallas(words, PATTERN, interpret=interpret)
+             if use_pallas else mark_words_xla(words, PATTERN))
+    starts, nhits = compact_word_matches(wmask, nbytes, cap)
+    ustarts = starts + np.int32(len(PATTERN))
 
-        def body(st):
-            win = unaligned_words(words, st, w1)
-            length = first_byte_pos(win, QUOTE)
-            ids, alt = _hash2(win, length)
-            return ids, alt, length
+    def body(st):
+        win = unaligned_words(words, st, w1)
+        length = first_byte_pos(win, QUOTE)
+        ids, alt = _hash2(win, length)
+        return ids, alt, length
 
-        ids, alts, lengths = lax.map(body, ustarts.reshape(-1, bs))
-        ids = ids.reshape(-1)
-        alts = alts.reshape(-1)
-        lengths = lengths.reshape(-1)
+    ids, alts, lengths = lax.map(body, ustarts.reshape(-1, bs))
+    ids = ids.reshape(-1)
+    alts = alts.reshape(-1)
+    lengths = lengths.reshape(-1)
 
-        if wide:
-            nlong = jnp.int32(0)
-        else:
-            # long tail: quote beyond the 64-byte window → re-gather 256 B
-            is_long = (lengths < 0) & (starts < nbytes)
-            nlong = jnp.sum(is_long.astype(jnp.int32))
-            pos = jnp.cumsum(is_long.astype(jnp.int32)) - 1
-            tgt = jnp.where(is_long & (pos < cap_long), pos, cap_long)
-            lidx = jnp.full(cap_long, cap, jnp.int32).at[tgt].set(
-                jnp.arange(cap, dtype=jnp.int32), mode="drop")
-            lst = jnp.where(lidx < cap,
-                            jnp.take(ustarts, jnp.minimum(lidx, cap - 1)),
-                            jnp.int32(nbytes))
-            lwin = unaligned_words(words, lst, nw)
-            lln = first_byte_pos(lwin, QUOTE)
-            lln = jnp.where(lln >= _W_SHORT * 4, lln, jnp.int32(-1))
-            lids, lalt = _hash2(lwin, lln)
-            ids = ids.at[lidx].set(lids, mode="drop")
-            alts = alts.at[lidx].set(lalt, mode="drop")
-            lengths = lengths.at[lidx].set(lln, mode="drop")
-            nlong = jnp.where(nlong > cap_long, nlong, 0).astype(jnp.int32)
-        docs = (jnp.searchsorted(file_starts, starts, side="right")
-                .astype(jnp.int32) - 1)
-        valid = (starts < nbytes) & (lengths >= 0)
-        npairs = jnp.sum(valid.astype(jnp.int32))
-        order = jnp.argsort(~valid, stable=True)   # valid rows first
-        pack = lambda x: jnp.take(x, order, axis=0)
-        pids, palts = pack(ids), pack(alts)
-        # collision check fused into the same dispatch (one id sort over
-        # cap rows — cheap next to the corpus passes, and it saves a
-        # round trip per run); multi-batch runs re-check globally
-        ncoll = _count_collisions(pids, palts, jnp.arange(cap) < npairs)
-        return (pids, palts, pack(docs).astype(jnp.uint32),
-                pack(ustarts), pack(lengths), nhits, npairs, ncoll, nlong)
+    if wide:
+        nlong = jnp.int32(0)
+    else:
+        # long tail: quote beyond the 64-byte window → re-gather 256 B
+        is_long = (lengths < 0) & (starts < nbytes)
+        nlong = jnp.sum(is_long.astype(jnp.int32))
+        pos = jnp.cumsum(is_long.astype(jnp.int32)) - 1
+        tgt = jnp.where(is_long & (pos < cap_long), pos, cap_long)
+        lidx = jnp.full(cap_long, cap, jnp.int32).at[tgt].set(
+            jnp.arange(cap, dtype=jnp.int32), mode="drop")
+        lst = jnp.where(lidx < cap,
+                        jnp.take(ustarts, jnp.minimum(lidx, cap - 1)),
+                        jnp.int32(nbytes))
+        lwin = unaligned_words(words, lst, nw)
+        lln = first_byte_pos(lwin, QUOTE)
+        lln = jnp.where(lln >= _W_SHORT * 4, lln, jnp.int32(-1))
+        lids, lalt = _hash2(lwin, lln)
+        ids = ids.at[lidx].set(lids, mode="drop")
+        alts = alts.at[lidx].set(lalt, mode="drop")
+        lengths = lengths.at[lidx].set(lln, mode="drop")
+        nlong = jnp.where(nlong > cap_long, nlong, 0).astype(jnp.int32)
+    docs = (jnp.searchsorted(file_starts, starts, side="right")
+            .astype(jnp.int32) - 1)
+    valid = (starts < nbytes) & (lengths >= 0)
+    npairs = jnp.sum(valid.astype(jnp.int32))
+    order = jnp.argsort(~valid, stable=True)   # valid rows first
+    pack = lambda x: jnp.take(x, order, axis=0)
+    pids, palts = pack(ids), pack(alts)
+    # collision check fused into the same dispatch (one id sort over
+    # cap rows — cheap next to the corpus passes, and it saves a
+    # round trip per run); multi-batch runs re-check globally
+    ncoll = _count_collisions(pids, palts, jnp.arange(cap) < npairs)
+    return (pids, palts, pack(docs).astype(jnp.uint32),
+            pack(ustarts), pack(lengths), nhits, npairs, ncoll, nlong)
 
-    return run
+
+def _extract_build(cap: int, use_pallas: bool, interpret: bool, wide: bool):
+    return jax.jit(functools.partial(
+        _extract_core, cap=cap, use_pallas=use_pallas,
+        interpret=interpret, wide=wide))
+
+
+@functools.lru_cache(maxsize=None)
+def _extract_mesh_fn(mesh, cap: int, use_pallas: bool, interpret: bool,
+                     wide: bool):
+    """Per-device ingestion (VERDICT r2 #2): ONE SPMD program runs the
+    fused extract on every shard's own corpus block — the reference's
+    'each rank maps its own files on its own GPU'
+    (cuda/InvertedIndex.cu:284-312) as a shard_map.  Global inputs:
+    words [P*W] (each shard's padded corpus), fstarts [P*F], doc base
+    [P]; outputs are the packed per-shard columns [P*cap] plus [P]
+    per-shard stats, all row-sharded — nothing materialises on the
+    controller."""
+    from ..parallel.mesh import row_spec
+    rspec = row_spec(mesh)
+
+    def body(words, fstarts, base):
+        (ids, alts, docs, ustarts, lengths, nhits, npairs, ncoll,
+         nlong) = _extract_core(words, fstarts, cap=cap,
+                                use_pallas=use_pallas,
+                                interpret=interpret, wide=wide)
+        docs = docs + base[0].astype(jnp.uint32)
+        one = lambda x: x.reshape(1)
+        return (ids, alts, docs, ustarts, lengths, one(nhits),
+                one(npairs), one(ncoll), one(nlong))
+
+    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
+    # annotation, which the checker would otherwise reject
+    sm = jax.shard_map(body, mesh=mesh, in_specs=(rspec, rspec, rspec),
+                       out_specs=(rspec,) * 9, check_vma=False)
+    return jax.jit(sm)
 
 
 def _count_collisions(ids, alts, valid):
@@ -203,6 +241,71 @@ def _count_collisions(ids, alts, valid):
     v = jnp.take(valid, order)
     return jnp.sum(((a[1:] == a[:-1]) & (b[1:] != b[:-1])
                     & v[1:] & v[:-1]).astype(jnp.int32))
+
+
+def _balance_files(files: Sequence[str], P: int):
+    """Split the file list into P CONTIGUOUS chunks of ~equal bytes (the
+    reference's consecutive per-proc file ranges,
+    cuda/InvertedIndex.cu:284-287).  Returns [(first_index, files,
+    sizes)]*P — sizes ride along so the batching step doesn't re-stat
+    every file."""
+    sizes = np.array([os.path.getsize(f) for f in files], np.int64)
+    total = max(int(sizes.sum()), 1)
+    mid = np.cumsum(sizes) - sizes // 2
+    assign = np.minimum((mid * P) // total, P - 1)  # non-decreasing
+    shards = []
+    i = 0
+    for p in range(P):
+        j = i
+        while j < len(files) and assign[j] == p:
+            j += 1
+        shards.append((i, list(files[i:j]), sizes[i:j]))
+        i = j
+    return shards
+
+
+def _bucket_words(nwords: int) -> int:
+    """Round a shard corpus word count up to a size bucket so shards (and
+    successive rounds) share one compiled SPMD program: next power of two
+    below 1M words, else next 1M-word (4 MB) multiple — ≤0.4% padding at
+    the 1 GiB batch cap."""
+    n = max(nwords, 64)
+    if n <= (1 << 20):
+        return 1 << (n - 1).bit_length()
+    g = 1 << 20
+    return -(-n // g) * g
+
+
+def _shard_blocks(arr, P: int):
+    """Per-shard host copies of a row-sharded global array [P*cap] —
+    device_get of each addressable shard, no global gather."""
+    cap = arr.shape[0] // P
+    out = [None] * P
+    for sh in arr.addressable_shards:
+        p = (sh.index[0].start or 0) // cap
+        out[p] = np.asarray(sh.data)
+    return out
+
+
+def _mesh_collision_count(checks) -> int:
+    """Global cross-shard/cross-round intern-collision count over per-
+    round sharded (ids, alts, counts) triples — one jitted sort, XLA
+    inserts the gather collectives; only the scalar reaches the host."""
+    ids = [c[0] for c in checks]
+    alts = [c[1] for c in checks]
+    valids = []
+    for ids_g, _, counts in checks:
+        cap = ids_g.shape[0] // len(counts)
+        v = (np.arange(cap)[None, :] < counts[:, None]).reshape(-1)
+        valids.append(jnp.asarray(v))
+
+    @jax.jit
+    def count(ids, alts, valids):
+        return _count_collisions(jnp.concatenate(ids),
+                                 jnp.concatenate(alts),
+                                 jnp.concatenate(valids))
+
+    return int(count(ids, alts, valids))
 
 
 def _url_dict_wanted(files, want_urls: bool) -> bool:
@@ -237,16 +340,6 @@ def _assemble_parts(parts):
         return jnp.concatenate(pieces)
 
     return cat(0), cat(1), cat(2), ntot
-
-
-@functools.lru_cache(maxsize=None)
-def _collision_check_fn():
-    @jax.jit
-    def run(ids, alts, npairs):
-        return _count_collisions(ids, alts,
-                                 jnp.arange(ids.shape[0]) < npairs)
-
-    return run
 
 
 class StageTimer:
@@ -434,13 +527,16 @@ class InvertedIndex:
     # -- map stage: fused device tier -------------------------------------
     _BATCH_BYTES = 1 << 30   # per-corpus cap: byte offsets are int32
 
-    def _file_batches(self, files):
+    def _file_batches(self, files, sizes=None):
         """Greedy contiguous file batches under the int32 corpus cap (the
         reference likewise works per-process file batches,
-        cuda/InvertedIndex.cu:284-287)."""
+        cuda/InvertedIndex.cu:284-287).  ``sizes``: optional pre-statted
+        byte counts aligned with ``files``."""
+        if sizes is None:
+            sizes = [os.path.getsize(f) for f in files]
         batches, cur, size = [], [], 0
-        for f in files:
-            fsz = os.path.getsize(f) + _GAP
+        for f, fbytes in zip(files, sizes):
+            fsz = int(fbytes) + _GAP
             if fsz > self._BATCH_BYTES:
                 raise ValueError(
                     f"{f}: single file of {fsz} bytes exceeds the device "
@@ -454,9 +550,122 @@ class InvertedIndex:
             batches.append(cur)
         return batches
 
-    def _map_corpus_device(self, files, kv, want_urls: bool):
+    def _map_corpus_mesh(self, mesh, files, kv, want_urls: bool):
+        """Mesh-SPMD map stage: every shard ingests ITS contiguous slice
+        of the file list and runs the fused extract on ITS device — the
+        controller never assembles a global corpus (VERDICT r2 #2).  A
+        shard's slice larger than the int32 corpus cap processes in
+        rounds; each round appends one ShardedKV frame."""
+        from ..parallel.mesh import mesh_axis_size, row_sharding
+        from ..parallel.sharded import ShardedKV
+        P = mesh_axis_size(mesh)
         self.docs = list(files)
-        mesh1 = self._single_device_mesh()
+        keep_bytes = _url_dict_wanted(files, want_urls)
+        batch_lists = []
+        for start, chunk, sizes in _balance_files(files, P):
+            bl, base = [], start
+            for b in (self._file_batches(chunk, sizes) if chunk else []):
+                bl.append((base, b))
+                base += len(b)
+            batch_lists.append(bl)
+        nrounds = max((len(b) for b in batch_lists), default=0)
+        if nrounds == 0:
+            return
+        sharding = row_sharding(mesh)
+        checks = []     # per-round (ids, alts, counts) for the global check
+        for r in range(nrounds):
+            per = []    # (doc_base, corpus, fstarts) per shard
+            for p in range(P):
+                if r < len(batch_lists[p]):
+                    base, batch = batch_lists[p][r]
+                    with self.timer.stage("read"):
+                        corpus, fstarts = _build_corpus(batch)
+                    per.append((base, corpus, fstarts))
+                else:
+                    per.append((0, np.zeros(0, np.uint8),
+                                np.zeros(0, np.int32)))
+            max_bytes = max(len(c[1]) for c in per)
+            if max_bytes == 0:
+                continue
+            W = _bucket_words(-(-max_bytes // 4))
+            F = max(max(len(c[2]) for c in per), 1)
+            words_host = []
+            fstarts_host = np.full((P, F), np.int32(4 * W), np.int32)
+            base_host = np.zeros(P, np.uint32)
+            for p, (base, corpus, fstarts) in enumerate(per):
+                w = bytes_view_u32(corpus)
+                wp = np.zeros(W, np.uint32)
+                wp[:len(w)] = w
+                words_host.append(wp)
+                fstarts_host[p, :len(fstarts)] = fstarts
+                base_host[p] = base
+            with self.timer.stage("h2d"):
+                # each shard's block goes straight to ITS device — the
+                # callback hands jax the per-shard host buffer for the
+                # slice it asks for; no [P*W] host concatenation
+                words_g = jax.make_array_from_callback(
+                    (P * W,), sharding,
+                    lambda idx: words_host[(idx[0].start or 0) // W])
+                fstarts_g = jax.device_put(fstarts_host.reshape(-1),
+                                           sharding)
+                base_g = jax.device_put(base_host, sharding)
+                jax.block_until_ready(words_g)
+
+            cap = max(8, 1 << (max(1, max_bytes // 1024) - 1).bit_length())
+            wide = False
+            with self.timer.stage("map_device"):
+                while True:
+                    fn = _extract_mesh_fn(mesh, cap, self.use_pallas,
+                                          self.interpret, wide)
+                    (ids, alts, docs, ustarts, lengths, nhits, npairs,
+                     ncoll, nlong) = fn(words_g, fstarts_g, base_g)
+                    nhits_h, npairs_h, ncoll_h, nlong_h = map(
+                        np.asarray,
+                        jax.device_get((nhits, npairs, ncoll, nlong)))
+                    mx = int(nhits_h.max())
+                    if mx > cap:
+                        cap = max(8, 1 << (mx - 1).bit_length())  # retry
+                    elif int(nlong_h.max()):
+                        wide = True   # a shard is long-URL-dense
+                    else:
+                        break
+                if int(ncoll_h.sum()):
+                    raise ValueError(
+                        f"{int(ncoll_h.sum())} 64-bit URL intern "
+                        f"collision(s) detected")
+            counts = npairs_h.astype(np.int32)
+            kv.add_frame(ShardedKV(mesh, ids, docs, counts))
+            if P > 1 or nrounds > 1:
+                checks.append((ids, alts, counts))
+
+            if keep_bytes:
+                with self.timer.stage("url_dict"):
+                    us = _shard_blocks(ustarts, P)
+                    ln = _shard_blocks(lengths, P)
+                    ih = _shard_blocks(ids, P)
+                    for p, (base, corpus, fstarts) in enumerate(per):
+                        n = int(counts[p])
+                        if n:
+                            urls = [corpus[s:s + l].tobytes()
+                                    for s, l in zip(us[p][:n].tolist(),
+                                                    ln[p][:n].tolist())]
+                            self._intern(ih[p][:n], urls)
+
+        if checks:
+            with self.timer.stage("map_device"):
+                ncoll = _mesh_collision_count(tuple(checks))
+                if ncoll:
+                    raise ValueError(
+                        f"{ncoll} 64-bit URL intern collision(s) detected "
+                        f"(distinct URLs share a u64 id)")
+
+    def _map_corpus_device(self, files, kv, want_urls: bool):
+        mesh = self._mesh()
+        if mesh is not None:
+            return self._map_corpus_mesh(mesh, files, kv, want_urls)
+        # serial-backend path: device extract, host KV (the mesh backend
+        # takes the SPMD path above)
+        self.docs = list(files)
         parts = []          # per batch: (ids, alts, docs, npairs) device
         corpora = []        # per batch: (corpus, ustarts, lengths, ids)
         doc_base = 0
@@ -505,22 +714,10 @@ class InvertedIndex:
         with self.timer.stage("map_device"):
             multi = len(parts) > 1
             ids, alts, docs, npairs = _assemble_parts(parts)
-            if mesh1 is not None:
-                # zero-copy into the sharded KV: the packed device columns
-                # ARE the shard (P=1; capacity is a power of two >= 8);
-                # aggregate/convert/reduce stay on device.  Per-batch
-                # collisions were checked inside extract; a multi-batch
-                # merge needs the global cross-batch check
-                from ..parallel.sharded import ShardedKV
-                kv.add_frame(ShardedKV(mesh1, ids, docs,
-                                       np.array([npairs], np.int32)))
-                ncoll = (int(_collision_check_fn()(
-                    ids, alts, jnp.int32(npairs))) if multi else 0)
-            else:
-                ids_h = np.asarray(ids[:npairs])
-                alts_h = np.asarray(alts[:npairs])
-                kv.add_batch(ids_h, np.asarray(docs[:npairs]))
-                ncoll = _host_collision_count(ids_h, alts_h) if multi else 0
+            ids_h = np.asarray(ids[:npairs])
+            alts_h = np.asarray(alts[:npairs])
+            kv.add_batch(ids_h, np.asarray(docs[:npairs]))
+            ncoll = _host_collision_count(ids_h, alts_h) if multi else 0
             if ncoll:
                 raise ValueError(
                     f"{ncoll} 64-bit URL intern collision(s) detected "
@@ -536,11 +733,10 @@ class InvertedIndex:
                             for s, l in zip(st.tolist(), ln.tolist())]
                     self._intern(idh, urls)
 
-    def _single_device_mesh(self):
+    def _mesh(self):
         from ..parallel.backend import MeshBackend
         mr = getattr(self, "_mr", None)
-        if (mr is not None and isinstance(mr.backend, MeshBackend)
-                and mr.backend.nprocs == 1):
+        if mr is not None and isinstance(mr.backend, MeshBackend):
             return mr.backend.mesh
         return None
 

@@ -67,11 +67,33 @@ def triangles(edges: np.ndarray, use_device: Optional[bool] = None
     n = len(verts)
     a = inv.reshape(-1, 2)[:, 0]
     b = inv.reshape(-1, 2)[:, 1]
+    return triangles_ranked(a, b, n, verts, use_device, canonical=True)
 
-    deg = np.bincount(inv, minlength=n)
-    # orient a→b from the smaller (degree, id); rank = deg*n + id is a
-    # total order and fits u64 for any n < 2^32 (same guard as rmat.py)
+
+def triangles_ranked(a: np.ndarray, b: np.ndarray, n: int,
+                     verts: np.ndarray,
+                     use_device: Optional[bool] = None,
+                     canonical: bool = False) -> np.ndarray:
+    """Triangles from pre-ranked endpoints (0..n-1) plus the rank→id
+    table ``verts`` — the entry point for device-staged edges
+    (parallel/staging.py ranks on the mesh; only the int32 rank columns
+    reach the host).  ``canonical=False`` dedupes/orients here."""
+    if n == 0 or len(a) == 0:
+        return np.zeros((0, 3), np.uint64)
     assert n < 2**32, f"triangles(): {n} vertices overflow u64 rank packing"
+    if not canonical:
+        lo0 = np.minimum(a, b).astype(np.uint64)
+        hi0 = np.maximum(a, b).astype(np.uint64)
+        keep = lo0 != hi0
+        ek = np.unique(lo0[keep] * np.uint64(n) + hi0[keep])
+        if len(ek) == 0:
+            return np.zeros((0, 3), np.uint64)
+        a = (ek // np.uint64(n)).astype(np.int64)
+        b = (ek % np.uint64(n)).astype(np.int64)
+
+    deg = np.bincount(a, minlength=n) + np.bincount(b, minlength=n)
+    # orient a→b from the smaller (degree, id); rank = deg*n + id is a
+    # total order and fits u64 for any n < 2^32 (asserted above)
     rank = deg.astype(np.uint64) * np.uint64(n) + np.arange(n, dtype=np.uint64)
     swap = rank[a] > rank[b]
     lo = np.where(swap, b, a)

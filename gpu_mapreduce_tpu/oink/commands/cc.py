@@ -264,31 +264,49 @@ class CCFind(Command):
         obj = self.obj
         mre = obj.input(1, read_edge)
 
-        edges: list = []
-        mre.scan_kv(lambda fr, p: edges.append(kv_keys(fr)), batch=True)
-        e = (np.concatenate(edges) if edges
-             else np.zeros((0, 2), np.uint64))
-        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
-        n = len(verts)
-        if n == 0:
-            self.ncc, self.niterate = 0, 0
-            mrv = obj.create_mr()
-            obj.output(1, mrv, print_vertex_value)
-            self.message("CC_find: 0 components in 0 iterations")
-            obj.cleanup()
-            return
-        src = inv.reshape(-1, 2)[:, 0]
-        dst = inv.reshape(-1, 2)[:, 1]
-
         from jax.sharding import Mesh
-
-        from ...models.cc import cc, cc_sharded
         mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        fr = None
         if mesh is not None:
-            labels, iters = cc_sharded(mesh, src, dst, n)
+            # device staging (VERDICT r2 #2): shard the edge KV once,
+            # rank vertices ON DEVICE — the O(E) edge columns never
+            # reach the controller; only n and the [n] id table do
+            from ...parallel.staging import (rank_edges, staged_frame,
+                                             unique_verts)
+            fr = staged_frame(mre)
+        if fr is not None and len(fr):
+            from ...models.cc import _cc_sharded_fn
+            verts_d, n = unique_verts(fr)
+            src_d, dst_d, valid_d = rank_edges(fr, verts_d)
+            labels_d, iters = _cc_sharded_fn(mesh, n, max(n, 1))(
+                src_d, dst_d, valid_d)
+            verts = np.asarray(verts_d)[:n]
+            labels, iters = np.asarray(labels_d), int(iters)
         else:
-            labels, iters = cc(src.astype(np.int32), dst.astype(np.int32), n)
-            labels, iters = np.asarray(labels), int(iters)
+            edges: list = []
+            mre.scan_kv(lambda fr, p: edges.append(kv_keys(fr)),
+                        batch=True)
+            e = (np.concatenate(edges) if edges
+                 else np.zeros((0, 2), np.uint64))
+            verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+            n = len(verts)
+            if n == 0:
+                self.ncc, self.niterate = 0, 0
+                mrv = obj.create_mr()
+                obj.output(1, mrv, print_vertex_value)
+                self.message("CC_find: 0 components in 0 iterations")
+                obj.cleanup()
+                return
+            src = inv.reshape(-1, 2)[:, 0]
+            dst = inv.reshape(-1, 2)[:, 1]
+
+            from ...models.cc import cc, cc_sharded
+            if mesh is not None:
+                labels, iters = cc_sharded(mesh, src, dst, n)
+            else:
+                labels, iters = cc(src.astype(np.int32),
+                                   dst.astype(np.int32), n)
+                labels, iters = np.asarray(labels), int(iters)
 
         zones = verts[labels]               # min vertex id per component
         self.ncc = int(len(np.unique(labels)))

@@ -282,35 +282,56 @@ class LubyFind(Command):
         obj = self.obj
         mre = obj.input(1, read_edge)
 
-        ecols: list = []
-        mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)), batch=True)
-        e = (np.concatenate(ecols) if ecols
-             else np.zeros((0, 2), np.uint64)).astype(np.uint64)
-        e = e[e[:, 0] != e[:, 1]]            # self-loops never block a MIS
-        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
-        n = len(verts)
-        if n == 0:
-            self.nset, self.niterate = 0, 0
-            mrv = obj.create_mr()
-            obj.output(1, mrv, print_vertex)
-            self.message("Luby_find: 0 MIS vertices in 0 iterations")
-            obj.cleanup()
-            return
-        src = inv.reshape(-1, 2)[:, 0]
-        dst = inv.reshape(-1, 2)[:, 1]
-        prio = vertex_rand(verts, self.seed)
-
         from jax.sharding import Mesh
-
-        from ...models.luby import luby_mis, luby_mis_sharded
         mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        fr = None
         if mesh is not None:
-            state, iters = luby_mis_sharded(mesh, src, dst, prio, n)
-        else:
-            state, iters = luby_mis(src.astype(np.int32),
-                                    dst.astype(np.int32),
-                                    jnp.asarray(prio), n)
-            state, iters = np.asarray(state), int(iters)
+            # device staging (VERDICT r2 #2): vertex ranking on device;
+            # self-loops dropped in the valid mask, matching the host
+            # path's pre-unique filter
+            from ...parallel.staging import (rank_edges, staged_frame,
+                                             unique_verts)
+            fr = staged_frame(mre)
+        state = None
+        if fr is not None and len(fr):
+            from ...models.luby import _luby_sharded_fn
+            verts_d, n = unique_verts(fr, drop_self=True)
+            if n:
+                src_d, dst_d, valid_d = rank_edges(fr, verts_d,
+                                                   drop_self=True)
+                verts = np.asarray(verts_d)[:n]
+                prio = vertex_rand(verts, self.seed)
+                state_d, iters = _luby_sharded_fn(mesh, n, max(n, 1))(
+                    src_d, dst_d, valid_d, jnp.asarray(prio))
+                state, iters = np.asarray(state_d), int(iters)
+        if state is None:
+            ecols: list = []
+            mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)),
+                        batch=True)
+            e = (np.concatenate(ecols) if ecols
+                 else np.zeros((0, 2), np.uint64)).astype(np.uint64)
+            e = e[e[:, 0] != e[:, 1]]        # self-loops never block a MIS
+            verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+            n = len(verts)
+            if n == 0:
+                self.nset, self.niterate = 0, 0
+                mrv = obj.create_mr()
+                obj.output(1, mrv, print_vertex)
+                self.message("Luby_find: 0 MIS vertices in 0 iterations")
+                obj.cleanup()
+                return
+            src = inv.reshape(-1, 2)[:, 0]
+            dst = inv.reshape(-1, 2)[:, 1]
+            prio = vertex_rand(verts, self.seed)
+
+            from ...models.luby import luby_mis, luby_mis_sharded
+            if mesh is not None:
+                state, iters = luby_mis_sharded(mesh, src, dst, prio, n)
+            else:
+                state, iters = luby_mis(src.astype(np.int32),
+                                        dst.astype(np.int32),
+                                        jnp.asarray(prio), n)
+                state, iters = np.asarray(state), int(iters)
 
         mis = verts[state == 1]
         self.nset, self.niterate = int(len(mis)), int(iters)

