@@ -626,8 +626,7 @@ class MapReduce:
             return self._sort_kv_external(kv, by, flag_or_cmp < 0, t)
         fr = kv.one_frame()
         if not isinstance(fr, KVFrame):
-            interned = by == "key" and \
-                getattr(fr, "key_decode", None) is not None
+            interned = getattr(fr, f"{by}_decode", None) is not None
             if not callable(flag_or_cmp) and not interned:
                 # per-shard device sort
                 from ..parallel.group import sort_sharded
@@ -687,8 +686,11 @@ class MapReduce:
         new = self._new_kmv()
         for fr in kmv.frames():
             if not isinstance(fr, KMVFrame):  # ShardedKMV
-                if callable(flag_or_cmp):
-                    fr = fr.to_host()  # comparator callbacks serialize
+                if callable(flag_or_cmp) or fr.value_decode is not None:
+                    # comparator callbacks serialize; interned byte
+                    # values decode first — their ids are hashes, not
+                    # lexicographic order
+                    fr = fr.to_host()
                 else:
                     from ..parallel.group import sort_multivalues_sharded
                     new.push(sort_multivalues_sharded(

@@ -300,30 +300,24 @@ class SSSPCommand(Command):
 
         from jax.sharding import Mesh
         mesh = obj.comm if isinstance(obj.comm, Mesh) else None
-        fr = None
-        if mesh is not None:
-            # device staging (VERDICT r2 #2): vertex ranking on device;
-            # the weight column is already row-sharded and aligned with
-            # the ranked endpoints, so it feeds the fused loop as-is
-            from ...parallel.staging import (rank_edges, staged_frame,
-                                             unique_verts)
-            fr = staged_frame(mredge)
-        bf = None
-        if fr is not None and len(fr):
+        # device staging (VERDICT r2 #2): vertex ranking on device; the
+        # weight column is row-sharded aligned with the ranked endpoints
+        # (need_weights guards against interned byte values, whose u64
+        # ids are not numbers)
+        from ...parallel.staging import stage_graph
+        sg = stage_graph(mredge, obj.comm, need_weights=True)
+        if sg is not None and sg.n == 0:
+            raise MRError("sssp: empty edge list")
+        if sg is not None:
             from ...models.sssp import _bf_sharded_fn
-            verts_d, n = unique_verts(fr)
-            if n == 0:
-                raise MRError("sssp: empty edge list")
-            src_d, dst_d, valid_d = rank_edges(fr, verts_d)
-            verts = np.asarray(verts_d)[:n]
-            w_d = fr.value
+            verts, n = sg.verts, sg.n
             fn = _bf_sharded_fn(mesh, n, max(n, 1))
 
             def bf(sidx):
-                dist, pred, it = fn(src_d, dst_d, w_d, valid_d,
+                dist, pred, it = fn(sg.src, sg.dst, sg.weights, sg.valid,
                                     jnp.int32(sidx))
                 return np.asarray(dist), np.asarray(pred), int(it)
-        if bf is None:
+        else:
             ecols: list = []
             mredge.scan_kv(lambda fr, p: ecols.append(
                 (kv_keys(fr), kv_values(fr))), batch=True)

@@ -74,4 +74,5 @@ def broadcast_kv(backend, mr, root: int):
     moved = int(skv.counts[root]) * (backend.nprocs - 1) * rowbytes
     mr.counters.add(cssize=moved, crsize=moved)
     _replace_kv_frames(mr.kv, ShardedKV(mesh, k, v, counts,
-                                        key_decode=skv.key_decode))
+                                        key_decode=skv.key_decode,
+                                        value_decode=skv.value_decode))

@@ -134,6 +134,22 @@ def _concat_jit(mesh):
     return run
 
 
+def _merge_decode(ta, tb, what: str):
+    """Union two id→key/value intern tables (None means plain ids; mixing
+    plain with interned would merge two incompatible spaces)."""
+    if (ta is None) != (tb is None):
+        raise ValueError(
+            f"cannot add an interned byte/object-{what}ed mesh dataset "
+            f"to a plain one: the merge would span two {what} spaces")
+    if not tb:
+        return ta
+    from ..core.column import InternTable
+    kind = ("object" if "object" in (getattr(ta, "kind", "bytes"),
+                                     getattr(tb, "kind", "bytes"))
+            else "bytes")
+    return InternTable({**ta, **tb}, kind=kind)
+
+
 def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
     """Per-shard concatenation of two mesh KV datasets (the device path of
     ``MapReduce::add``, src/mapreduce.cpp:348-374)."""
@@ -142,19 +158,11 @@ def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
                                    row_sharding(a.mesh))
     k, v, c = _concat_jit(a.mesh)(a.key, a.value, put(a), b.key, b.value,
                                   put(b))
-    if (a.key_decode is None) != (b.key_decode is None):
-        raise ValueError(
-            "cannot add an interned byte/object-keyed mesh dataset to a "
-            "plain-keyed one: the merged keys would span two key spaces")
-    kd = a.key_decode
-    if b.key_decode:
-        from ..core.column import InternTable
-        kind = ("object" if "object" in (
-            getattr(a.key_decode, "kind", "bytes"),
-            getattr(b.key_decode, "kind", "bytes")) else "bytes")
-        kd = InternTable({**a.key_decode, **b.key_decode}, kind=kind)
     return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32),
-                     key_decode=kd)
+                     key_decode=_merge_decode(a.key_decode, b.key_decode,
+                                              "key"),
+                     value_decode=_merge_decode(a.value_decode,
+                                                b.value_decode, "value"))
 
 
 def clone_sharded(skv: ShardedKV) -> ShardedKMV:
@@ -168,7 +176,8 @@ def clone_sharded(skv: ShardedKV) -> ShardedKMV:
                       jax.device_put(nv.reshape(-1), sharding),
                       jax.device_put(vo.reshape(-1), sharding),
                       skv.value, skv.counts.copy(), skv.counts.copy(),
-                      key_decode=skv.key_decode)
+                      key_decode=skv.key_decode,
+                      value_decode=skv.value_decode)
 
 
 # ---------------------------------------------------------------------------

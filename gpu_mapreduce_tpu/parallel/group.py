@@ -108,7 +108,8 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
     nvalues = jax.device_put(nvalues, row_sharding(mesh))
     voffsets = jax.device_put(voffsets, row_sharding(mesh))
     return ShardedKMV(skv.mesh, ukey, nvalues, voffsets, svalue,
-                      gcounts, skv.counts.copy(), key_decode=skv.key_decode)
+                      gcounts, skv.counts.copy(), key_decode=skv.key_decode,
+                      value_decode=skv.value_decode)
 
 
 def _clamp_sizes(nvalues, voffsets, gcounts, vcounts, gcap):
@@ -190,6 +191,11 @@ def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
     """Vectorised reduce: one output pair per group, computed with XLA
     segment ops per shard (count/sum/max/min).  Cached per (mesh, gcap,
     op, transform identity)."""
+    if kmv.value_decode is not None and op != "count":
+        raise ValueError(
+            f"reduce_sharded({op!r}): values are interned byte/object "
+            f"ids — arithmetic on them is meaningless; decode to host "
+            f"first (only 'count' is value-agnostic)")
     run = _reduce_jit(kmv.mesh, kmv.gcap, op, values_transform)
     vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32),
                                  row_sharding(kmv.mesh))
@@ -233,7 +239,8 @@ def first_sharded(kmv: ShardedKMV) -> ShardedKV:
     """One output pair per group with the group's FIRST value (dedupe/cull)."""
     uk, v = _first_jit(kmv.mesh)(kmv.ukey, kmv.voffsets, kmv.values)
     return ShardedKV(kmv.mesh, uk, v, kmv.gcounts.copy(),
-                     key_decode=kmv.key_decode)
+                     key_decode=kmv.key_decode,
+                     value_decode=kmv.value_decode)
 
 
 @functools.lru_cache(maxsize=None)
@@ -268,7 +275,8 @@ def sort_multivalues_sharded(kmv: ShardedKMV,
         kmv.voffsets, kmv.nvalues, kmv.values, vcounts_dev)
     return ShardedKMV(kmv.mesh, kmv.ukey, kmv.nvalues, kmv.voffsets, values,
                       kmv.gcounts.copy(), kmv.vcounts.copy(),
-                      key_decode=kmv.key_decode)
+                      key_decode=kmv.key_decode,
+                      value_decode=kmv.value_decode)
 
 
 def _desc_key(v):
@@ -310,4 +318,5 @@ def sort_sharded(skv: ShardedKV, by: str = "key",
                                 row_sharding(skv.mesh))
     k, v = _sort_jit(skv.mesh, by, descending)(skv.key, skv.value, counts_dev)
     return ShardedKV(skv.mesh, k, v, skv.counts.copy(),
-                     key_decode=skv.key_decode)
+                     key_decode=skv.key_decode,
+                     value_decode=skv.value_decode)

@@ -92,6 +92,8 @@ class ShardedKV:
     value: jax.Array      # [P*cap] or [P*cap, w]
     counts: np.ndarray    # host [P] int32
     key_decode: dict = None
+    value_decode: dict = None   # id→bytes/object when VALUES are interned
+    #                             (VERDICT r2 #4: byte values shard too)
 
     @property
     def nprocs(self) -> int:
@@ -125,7 +127,10 @@ class ShardedKV:
             np.zeros(0, np.int64)
         key_col = (_decode_col(self.key_decode, k[keep])
                    if self.key_decode is not None else DenseColumn(k[keep]))
-        return KVFrame(key_col, DenseColumn(v[keep]))
+        val_col = (_decode_col(self.value_decode, v[keep])
+                   if self.value_decode is not None
+                   else DenseColumn(v[keep]))
+        return KVFrame(key_col, val_col)
 
     def pairs(self) -> Iterator[Tuple[object, object]]:
         yield from self.to_host().pairs()
@@ -151,6 +156,7 @@ class ShardedKMV:
     gcounts: np.ndarray   # host [P]
     vcounts: np.ndarray   # host [P]
     key_decode: dict = None   # see ShardedKV.key_decode
+    value_decode: dict = None  # see ShardedKV.value_decode
 
     @property
     def nprocs(self) -> int:
@@ -207,8 +213,11 @@ class ShardedKMV:
         idx = (np.repeat(starts - offsets[:-1], nvalues)
                + np.arange(total, dtype=np.int64))
         values = vals[idx]
+        val_col = (_decode_col(self.value_decode, values)
+                   if self.value_decode is not None
+                   else DenseColumn(values))
         return KMVFrame(key_col if key_col is not None else DenseColumn(key),
-                        nvalues, offsets, DenseColumn(values))
+                        nvalues, offsets, val_col)
 
     def groups(self):
         yield from self.to_host().groups()

@@ -315,7 +315,8 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         moved = int(counts_mat.sum() - np.trace(counts_mat)) * rowbytes
         counters.add(cssize=moved, crsize=moved)
     return ShardedKV(mesh, out_k, out_v, new_counts,
-                     key_decode=skv.key_decode)
+                     key_decode=skv.key_decode,
+                     value_decode=skv.value_decode)
 
 
 # ---------------------------------------------------------------------------
@@ -335,9 +336,9 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
         _aggregate_host_hash(backend, mr, hash_fn)
         return
     frame = kv.one_frame()
-    table = None
-    if isinstance(frame, KVFrame) and _values_shardable(frame):
-        frame, table = _intern_frame(frame)
+    ktable = vtable = None
+    if isinstance(frame, KVFrame):
+        frame, ktable, vtable = _intern_frame(frame)
     if mesh_axis_size(backend.mesh) == 1:
         # reference early-out for nprocs==1 (src/mapreduce.cpp:403-406):
         # no exchange — but a dense host frame still moves onto the device
@@ -346,20 +347,16 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
         if isinstance(frame, KVFrame):
             if frame.is_dense():
                 skv = shard_frame(frame, backend.mesh)
-                skv.key_decode = table
+                skv.key_decode = ktable
+                skv.value_decode = vtable
                 _replace_kv_frames(kv, skv)
         else:
             _replace_kv_frames(kv, frame)
         return
     if isinstance(frame, KVFrame):
-        if not frame.is_dense():
-            mr.error.warning(
-                "aggregate: byte-string VALUES stay host-resident; only "
-                "byte keys auto-intern for the device shuffle "
-                "(reference shuffles raw bytes, src/mapreduce.cpp:453-473)")
-            return
         skv = shard_frame(frame, backend.mesh)
-        skv.key_decode = table
+        skv.key_decode = ktable
+        skv.value_decode = vtable
     else:
         skv = frame  # already sharded
     t = Timer()
@@ -367,14 +364,6 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
                    counters=mr.counters)
     mr.counters.add(commtime=t.elapsed())
     _replace_kv_frames(kv, out)
-
-
-def _values_shardable(frame: KVFrame) -> bool:
-    """Whether the VALUE column can live on device — checked before the
-    key intern pass so byte-valued frames don't pay a full key hashing
-    round just to stay host-resident anyway."""
-    from ..core.column import DenseColumn
-    return isinstance(frame.value, DenseColumn)
 
 
 def _key_bytes_rows(col) -> list:
@@ -396,30 +385,34 @@ def _aggregate_host_hash(backend, mr, hash_fn):
         frame = frame.to_host()
     if len(frame) == 0:
         return
-    if not _values_shardable(frame):
-        mr.error.warning(
-            "aggregate(host hash): byte-string VALUES stay host-resident")
-        return
     dest = (np.asarray(hash_fn(_key_bytes_rows(frame.key)))
             .astype(np.int64) % P).astype(np.int32)
-    frame, table = _intern_frame(frame)
+    frame, ktable, vtable = _intern_frame(frame)
     order = np.argsort(dest, kind="stable")
     counts = np.bincount(dest, minlength=P).astype(np.int32)
     from .sharded import shard_frame_with_counts
     skv = shard_frame_with_counts(frame.take(order), backend.mesh, counts)
-    skv.key_decode = table
+    skv.key_decode = ktable
+    skv.value_decode = vtable
     _replace_kv_frames(kv, skv)
 
 
 def _intern_frame(frame: KVFrame):
-    """Byte-string or arbitrary-object KEYS intern to u64 ids for the
-    device shuffle; the id→key table stays controller-side and rides on
-    the ShardedKV (SURVEY.md §7 'hard parts'; VERDICT r1 #5)."""
+    """Byte-string or arbitrary-object KEYS and VALUES intern to u64 ids
+    for the device shuffle; the id→bytes tables stay controller-side and
+    ride on the ShardedKV (SURVEY.md §7 'hard parts'; VERDICT r1 #5 for
+    keys, r2 #4 for values — the reference shuffles raw bytes on both
+    sides, src/mapreduce.cpp:453-473)."""
     from ..core.column import BytesColumn, ObjectColumn
-    if isinstance(frame.key, (BytesColumn, ObjectColumn)):
-        ids, table = frame.key.intern()
-        return KVFrame(ids, frame.value), table
-    return frame, None
+    key, value = frame.key, frame.value
+    ktable = vtable = None
+    if isinstance(key, (BytesColumn, ObjectColumn)):
+        key, ktable = key.intern()
+    if isinstance(value, (BytesColumn, ObjectColumn)):
+        value, vtable = value.intern()
+    if ktable is None and vtable is None:
+        return frame, None, None
+    return KVFrame(key, value), ktable, vtable
 
 
 def _replace_kv_frames(kv, sharded_frame):

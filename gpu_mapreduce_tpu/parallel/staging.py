@@ -50,14 +50,52 @@ def mesh_kv_frame(mr) -> Optional[ShardedKV]:
 
 def staged_frame(mr) -> Optional[ShardedKV]:
     """Mesh-resident frame of mr's KV, aggregating (shard + hash
-    exchange) first if the data is still host-resident.  The shared
-    staging preamble of the fused graph commands; returns None when the
-    dataset cannot shard (empty, or byte values)."""
+    exchange) first if the data is still host-resident.  Returns None
+    only when the dataset is empty/absent.  NOTE: byte/object VALUES
+    shard as interned u64 ids (``value_decode`` set) — callers that
+    consume ``fr.value`` numerically must check ``value_decode``."""
     fr = mesh_kv_frame(mr)
     if fr is None:
         mr.aggregate()
         fr = mesh_kv_frame(mr)
     return fr
+
+
+class StagedGraph:
+    """Result of :func:`stage_graph`: ranked sharded edge arrays plus the
+    host-side [n] vertex-id table (pulled once, for output)."""
+
+    __slots__ = ("verts", "n", "src", "dst", "valid", "weights")
+
+    def __init__(self, verts, n, src, dst, valid, weights):
+        self.verts, self.n = verts, n
+        self.src, self.dst, self.valid = src, dst, valid
+        self.weights = weights
+
+
+def stage_graph(mr, comm, drop_self: bool = False,
+                need_weights: bool = False) -> Optional[StagedGraph]:
+    """The fused graph commands' shared staging: mesh-shard the edge KV,
+    rank vertices/edges on device.  Returns None when mesh staging does
+    not apply (no mesh comm, empty dataset, or — with ``need_weights`` —
+    interned byte values, whose ids are not numbers); the caller then
+    takes its host path.  An n==0 result carries empty arrays so callers
+    can emit their empty output without re-pulling the edge list."""
+    from jax.sharding import Mesh
+    if not isinstance(comm, Mesh):
+        return None
+    fr = staged_frame(mr)
+    if fr is None or not len(fr):
+        return None
+    if need_weights and fr.value_decode is not None:
+        return None
+    verts_d, n = unique_verts(fr, drop_self=drop_self)
+    if n == 0:
+        return StagedGraph(np.zeros(0, np.uint64), 0, None, None, None,
+                           None)
+    src_d, dst_d, valid_d = rank_edges(fr, verts_d, drop_self=drop_self)
+    return StagedGraph(np.asarray(verts_d)[:n], n, src_d, dst_d, valid_d,
+                       fr.value if need_weights else None)
 
 
 def _valid_rows(nrows: int, nprocs: int, counts):
@@ -69,9 +107,13 @@ def _valid_rows(nrows: int, nprocs: int, counts):
 @functools.lru_cache(maxsize=None)
 def _unique_fn(mesh, nrows: int, drop_self: bool):
     rep = NamedSharding(mesh, PartitionSpec())
+    shard = NamedSharding(mesh, row_spec(mesh))
     nprocs = mesh_axis_size(mesh)
 
-    @functools.partial(jax.jit, out_shardings=(rep, rep, rep))
+    # the sorted 2E table stays ROW-SHARDED here; only the [round_cap(n)]
+    # trim (second dispatch below) replicates — forcing rep on the full
+    # array would put O(E) on every device
+    @functools.partial(jax.jit, out_shardings=(shard, rep, rep))
     def run(key, counts):
         valid = _valid_rows(nrows, nprocs, counts)
         if drop_self:

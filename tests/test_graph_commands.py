@@ -639,3 +639,19 @@ def test_cc_fused_mesh_device_staging(graph_file, tmp_path):
            np.loadtxt(out, dtype=np.uint64).reshape(-1, 2)}
     assert got == oracle
     assert cmd.ncc == len(set(oracle.values()))
+
+
+def test_luby_self_loop_only_mesh(tmp_path):
+    """Staged luby with a self-loop-only graph emits the empty result
+    directly from the device staging (n==0), no host edge pull."""
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.parallel.sharded import ToHostStats
+
+    path = tmp_path / "loops.txt"
+    path.write_text("3 3\n7 7\n9 9\n")
+    obj = ObjectManager(comm=make_mesh(4))
+    snap = ToHostStats.snapshot()
+    cmd = run_command("luby_find", ["5"], obj=obj, inputs=[str(path)],
+                      screen=False)
+    assert (cmd.nset, cmd.niterate) == (0, 0)
+    assert ToHostStats.delta(snap) == (0, 0)

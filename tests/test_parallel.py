@@ -275,3 +275,62 @@ def test_sort_keys_lexicographic_after_intern():
     got = []
     mr.scan_kv(lambda k, v, p: got.append(k))
     assert got == sorted(words, reverse=True)
+
+
+def test_bytes_values_shard_and_roundtrip(mesh):
+    """VERDICT r2 #4: byte-string VALUES intern and shard like keys —
+    a (u64 key, bytes value) KV aggregates across the mesh, groups, and
+    reduces to the serial oracle with the original value bytes intact."""
+    import jax.numpy  # noqa: F401
+
+    def emit_bv(itask, kv, ptr):
+        rng = np.random.default_rng(40 + itask)
+        for _ in range(200):
+            k = int(rng.integers(0, 37))
+            kv.add(np.uint64(k), b"doc-%03d" % rng.integers(0, 50))
+
+    oracle = collections.defaultdict(list)
+    mr0 = MapReduce()
+    mr0.map(4, emit_bv)
+    mr0.scan_kv(lambda k, v, p: oracle[int(k)].append(bytes(v)))
+
+    mr = MapReduce(mesh)
+    mr.map(4, emit_bv)
+    mr.aggregate()
+    fr = mr.kv.one_frame()
+    assert isinstance(fr, ShardedKV) and fr.value_decode is not None
+    # round-trip: pairs decode to the original bytes
+    got = collections.defaultdict(list)
+    mr.scan_kv(lambda k, v, p: got[int(k)].append(bytes(v)))
+    assert {k: sorted(v) for k, v in got.items()} == \
+        {k: sorted(v) for k, v in oracle.items()}
+    # convert + host reduce sees decoded byte values per group
+    mr.convert()
+    sizes = {}
+    mr.reduce(lambda k, vals, kv, p: (
+        sizes.__setitem__(int(k), sorted(bytes(v) for v in vals)),
+        kv.add(k, len(vals))))
+    assert sizes == {k: sorted(v) for k, v in oracle.items()}
+
+
+def test_bytes_keys_and_values_wordpair(mesh):
+    """Both columns byte strings: (word, doc) pairs shuffle on ids for
+    both sides and print/scan reconstruct bytes on both sides."""
+    pairs = [(b"alpha", b"d1"), (b"beta", b"d2"), (b"alpha", b"d2"),
+             (b"gamma", b"d3"), (b"beta", b"d1"), (b"alpha", b"d1")]
+    mr = MapReduce(mesh)
+    mr.map(1, lambda i, kv, p: [kv.add(k, v) for k, v in pairs])
+    mr.aggregate()
+    fr = mr.kv.one_frame()
+    assert fr.key_decode is not None and fr.value_decode is not None
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append((bytes(k), bytes(v))))
+    assert sorted(got) == sorted(pairs)
+    mr.convert()
+    grouped = {}
+    mr.scan_kmv(lambda k, vals, p: grouped.__setitem__(
+        bytes(k), sorted(bytes(v) for v in vals)))
+    oracle = collections.defaultdict(list)
+    for k, v in pairs:
+        oracle[k].append(v)
+    assert grouped == {k: sorted(v) for k, v in oracle.items()}
