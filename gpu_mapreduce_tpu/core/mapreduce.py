@@ -413,6 +413,58 @@ class MapReduce:
         return (self.settings.outofcore == 1 and kv.nframes > 1
                 and kv.is_host_dataset())
 
+    def _hbm_budget_bytes(self) -> Optional[int]:
+        """Per-shard HBM budget for mesh datasets: maxpage frames ×
+        memsize MB — the device-tier reading of the reference's page
+        budget (every op runs in 1–7 fixed pages no matter the data,
+        doc/Interface_c++.txt:39-59).  None = unlimited (maxpage 0 or
+        in-core mode)."""
+        s = self.settings
+        if s.outofcore != 1 or s.maxpage == 0:
+            return None
+        return s.memsize * (1 << 20) * s.maxpage
+
+    def _mesh_over_budget(self, kv: KeyValue) -> bool:
+        """Whether the mesh-resident per-shard bytes of kv exceed the
+        HBM budget (VERDICT r2 #3)."""
+        budget = self._hbm_budget_bytes()
+        if budget is None or kv.is_host_dataset():
+            return False
+        from ..parallel.sharded import ShardedKV
+        per_shard = sum(f.nbytes() // max(f.nprocs, 1)
+                        for f in kv._frames if isinstance(f, ShardedKV))
+        return per_shard > budget
+
+    def _demote_mesh_kv(self) -> None:
+        """Stream every mesh frame's shard blocks to host frames under
+        the page budget (spilling beyond maxpage like any host dataset),
+        so convert/sort can run the bounded external path.  One shard
+        block is resident at a time; the device dataset frees at the
+        end."""
+        from .dataset import _split_to_budget
+        from ..parallel.sharded import ShardedKV
+        kv = self.kv
+        newkv = self._new_kv()
+        # kv.frames(), not kv._frames: spilled host frames load lazily
+        # (a _Spilled record has no to_host) and sharded frames stream
+        # per shard block
+        for fr in kv.frames():
+            if isinstance(fr, ShardedKV):
+                for p in range(fr.nprocs):
+                    if int(fr.counts[p]):
+                        for piece in _split_to_budget(
+                                fr.shard_to_host(p), self.settings):
+                            newkv._push_frame(piece)
+            else:
+                for piece in _split_to_budget(
+                        fr if isinstance(fr, KVFrame) else fr.to_host(),
+                        self.settings):
+                    newkv._push_frame(piece)
+        kv.free()
+        newkv.nkv = sum(newkv._frame_n(f) for f in newkv._frames)
+        newkv.complete_done = True
+        self.kv = newkv
+
     def convert(self) -> int:
         """Local KV→KMV grouping (reference src/mapreduce.cpp:861-886 →
         KeyMultiValue::convert; here sort+segment, SURVEY.md §3.3).  An
@@ -422,6 +474,11 @@ class MapReduce:
         t = self._begin_op()
         kv = self._require_kv("convert")
         self.kmv = self._new_kmv()
+        if self._mesh_over_budget(kv):
+            # a mesh dataset past the per-shard HBM budget demotes to
+            # host page frames and groups through the external path
+            self._demote_mesh_kv()
+            kv = self.kv
         if self._use_external(kv):
             from .external import external_sorted_chunks, group_stream
             chunks = external_sorted_chunks(kv.frames(), "key",
@@ -622,6 +679,9 @@ class MapReduce:
     def _sort_kv(self, by: str, flag_or_cmp) -> int:
         t = self._begin_op()
         kv = self._require_kv(f"sort_{by}s")
+        if self._mesh_over_budget(kv):
+            self._demote_mesh_kv()   # see convert(): HBM budget
+            kv = self.kv
         if not callable(flag_or_cmp) and self._use_external(kv):
             return self._sort_kv_external(kv, by, flag_or_cmp < 0, t)
         fr = kv.one_frame()

@@ -271,13 +271,8 @@ class CCFind(Command):
         # controller; only n and the [n] id table do
         from ...parallel.staging import stage_graph
         sg = stage_graph(mre, obj.comm)
-        if sg is not None and sg.n == 0:
-            self.ncc, self.niterate = 0, 0
-            mrv = obj.create_mr()
-            obj.output(1, mrv, print_vertex_value)
-            self.message("CC_find: 0 components in 0 iterations")
-            obj.cleanup()
-            return
+        # (sg.n == 0 cannot happen here: empty datasets return None and
+        # without drop_self every valid edge row has real endpoints)
         if sg is not None:
             from ...models.cc import _cc_sharded_fn
             labels_d, iters = _cc_sharded_fn(mesh, sg.n, max(sg.n, 1))(

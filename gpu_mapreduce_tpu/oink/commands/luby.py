@@ -290,15 +290,10 @@ class LubyFind(Command):
         from ...parallel.staging import stage_graph
         sg = stage_graph(mre, obj.comm, drop_self=True)
         if sg is not None and sg.n == 0:
-            # a self-loop-only/empty graph: the answer is already known —
-            # emit the empty output without re-pulling the edge list
-            self.nset, self.niterate = 0, 0
-            mrv = obj.create_mr()
-            obj.output(1, mrv, print_vertex)
-            self.message("Luby_find: 0 MIS vertices in 0 iterations")
-            obj.cleanup()
-            return
-        if sg is not None:
+            # a self-loop-only graph (drop_self left no vertices): empty
+            # state falls through to the shared epilogue — no edge pull
+            verts, state, iters = sg.verts, np.zeros(0, np.int8), 0
+        elif sg is not None:
             from ...models.luby import _luby_sharded_fn
             verts, n = sg.verts, sg.n
             prio = vertex_rand(verts, self.seed)

@@ -306,8 +306,8 @@ class SSSPCommand(Command):
         # ids are not numbers)
         from ...parallel.staging import stage_graph
         sg = stage_graph(mredge, obj.comm, need_weights=True)
-        if sg is not None and sg.n == 0:
-            raise MRError("sssp: empty edge list")
+        # (sg.n == 0 cannot happen: empty datasets return None and
+        # without drop_self every valid edge row has real endpoints)
         if sg is not None:
             from ...models.sssp import _bf_sharded_fn
             verts, n = sg.verts, sg.n

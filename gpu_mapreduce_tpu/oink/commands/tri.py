@@ -235,13 +235,10 @@ class TriFind(Command):
         sg = stage_graph(mre, obj.comm)
         if sg is not None:
             from ...models.tri import triangles_ranked
-            if sg.n == 0:
-                tris = np.zeros((0, 3), np.uint64)
-            else:
-                valid = np.asarray(sg.valid)
-                tris = triangles_ranked(np.asarray(sg.src)[valid],
-                                        np.asarray(sg.dst)[valid],
-                                        sg.n, sg.verts)
+            valid = np.asarray(sg.valid)
+            tris = triangles_ranked(np.asarray(sg.src)[valid],
+                                    np.asarray(sg.dst)[valid],
+                                    sg.n, sg.verts)
         else:
             ecols: list = []
             mre.scan_kv(lambda fr, p: ecols.append(kv_keys(fr)),

@@ -132,6 +132,28 @@ class ShardedKV:
                    else DenseColumn(v[keep]))
         return KVFrame(key_col, val_col)
 
+    def shard_to_host(self, p: int) -> KVFrame:
+        """Host KVFrame of ONE shard's valid rows — device_get of just
+        that shard's block (the HBM-budget demotion streams blocks one
+        at a time; ``to_host`` would materialise the whole dataset)."""
+        ToHostStats.kv += 1
+        cap = self.cap
+        n = int(self.counts[p])
+        k = v = None
+        for sh in self.key.addressable_shards:
+            if (sh.index[0].start or 0) == p * cap:
+                k = np.asarray(sh.data)[:n]
+                break
+        for sh in self.value.addressable_shards:
+            if (sh.index[0].start or 0) == p * cap:
+                v = np.asarray(sh.data)[:n]
+                break
+        key_col = (_decode_col(self.key_decode, k)
+                   if self.key_decode is not None else DenseColumn(k))
+        val_col = (_decode_col(self.value_decode, v)
+                   if self.value_decode is not None else DenseColumn(v))
+        return KVFrame(key_col, val_col)
+
     def pairs(self) -> Iterator[Tuple[object, object]]:
         yield from self.to_host().pairs()
 

@@ -138,3 +138,71 @@ def test_external_sort_multicolumn_keys(tmp_path, rng):
     got = np.concatenate([np.asarray(f.key.data) for f in mr.kv.frames()])
     order = np.lexsort((e[:, 1], e[:, 0]))
     np.testing.assert_array_equal(got, e[order])
+
+
+def _big_mesh_mr(tmp_path, rng, ndev=8):
+    import jax
+
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.parallel.sharded import ShardedKV
+
+    assert len(jax.devices()) >= ndev
+    mr = MapReduce(make_mesh(ndev), outofcore=1, memsize=MEMSIZE_MB,
+                   maxpage=1, fpath=str(tmp_path))
+    keys = rng.integers(0, 5000, NROWS).astype(np.uint64)
+    vals = rng.integers(0, 1 << 30, NROWS).astype(np.uint64)
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+    mr.aggregate()
+    fr = mr.kv.one_frame()
+    assert isinstance(fr, ShardedKV)
+    # genuinely past the per-shard HBM budget (maxpage * memsize)
+    assert fr.nbytes() // ndev > BUDGET
+    return mr, keys, vals
+
+
+def test_mesh_convert_over_hbm_budget(tmp_path, rng):
+    """VERDICT r2 #3: a mesh dataset ~10× the per-shard HBM budget
+    demotes shard blocks to host page frames and converts through the
+    bounded external path — correct groups, bounded msizemax."""
+    import collections
+
+    mr, keys, vals = _big_mesh_mr(tmp_path, rng)
+    c = _fresh_counters()
+    mr.convert()
+    assert c.msizemax <= 3 * BUDGET, f"peak {c.msizemax} vs {BUDGET}"
+    assert mr.kmv.nframes > 1          # streamed in pieces, not in-core
+    oracle = collections.Counter(keys.tolist())
+    got = {}
+    mr.reduce(lambda k, vlist, kv, p: got.__setitem__(int(k), len(vlist)))
+    assert got == dict(oracle)
+    assert c.msizemax <= 3 * BUDGET
+
+
+def test_mesh_sort_over_hbm_budget(tmp_path, rng):
+    """sort_keys on an over-budget mesh dataset takes the same demote +
+    external-merge route and stays bounded."""
+    mr, keys, vals = _big_mesh_mr(tmp_path, rng)
+    c = _fresh_counters()
+    mr.sort_keys(1)
+    assert c.msizemax <= 3 * BUDGET, f"peak {c.msizemax} vs {BUDGET}"
+    got_k = np.concatenate([np.asarray(f.key.data) for f in mr.kv.frames()])
+    np.testing.assert_array_equal(np.sort(got_k, kind="stable"), got_k)
+    np.testing.assert_array_equal(np.sort(keys), got_k)
+
+
+def test_mesh_demote_with_spilled_host_frames(tmp_path, rng):
+    """A KV mixing an over-budget ShardedKV with SPILLED host frames
+    demotes cleanly (spills load lazily via kv.frames()) and converts
+    to the dict oracle."""
+    import collections
+
+    mr, keys, vals = _big_mesh_mr(tmp_path, rng)
+    extra_k = rng.integers(0, 5000, BUDGET // 8).astype(np.uint64)
+    extra_v = np.ones(len(extra_k), np.uint64)
+    mr.map(1, lambda i, kv, p: kv.add_batch(extra_k, extra_v), addflag=1)
+    mr.convert()
+    oracle = collections.Counter(keys.tolist()) \
+        + collections.Counter(extra_k.tolist())
+    got = {}
+    mr.reduce(lambda k, vl, kv, p: got.__setitem__(int(k), len(vl)))
+    assert got == dict(oracle)
