@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from .group import _local_segment_ids
 from .mesh import row_sharding, row_spec
-from .sharded import ShardedKMV, ShardedKV
+from .sharded import ShardedKMV, ShardedKV, SyncStats
 
 U64MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
 
@@ -80,6 +80,7 @@ def skv_map(skv: ShardedKV, fn, static=(), extra=()) -> ShardedKV:
                             row_sharding(skv.mesh))
     k, v, c = _skv_map_jit(skv.mesh, fn, tuple(static), len(extra))(
         skv.key, skv.value, counts, *extra)
+    SyncStats.pulls += 1
     return ShardedKV(skv.mesh, k, v, np.asarray(c).astype(np.int32))
 
 
@@ -108,6 +109,7 @@ def skmv_map(kmv: ShardedKMV, fn, static=(), extra=()) -> ShardedKV:
     k, v, c = _skmv_map_jit(kmv.mesh, fn, tuple(static), len(extra))(
         kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values,
         put(kmv.gcounts), put(kmv.vcounts), *extra)
+    SyncStats.pulls += 1
     return ShardedKV(kmv.mesh, k, v, np.asarray(c).astype(np.int32))
 
 
@@ -158,6 +160,7 @@ def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
                                    row_sharding(a.mesh))
     k, v, c = _concat_jit(a.mesh)(a.key, a.value, put(a), b.key, b.value,
                                   put(b))
+    SyncStats.pulls += 1
     return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32),
                      key_decode=_merge_decode(a.key_decode, b.key_decode,
                                               "key"),

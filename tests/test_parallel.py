@@ -334,3 +334,73 @@ def test_bytes_keys_and_values_wordpair(mesh):
     for k, v in pairs:
         oracle[k].append(v)
     assert grouped == {k: sorted(v) for k, v in oracle.items()}
+
+
+def test_sort_interned_stays_on_device():
+    """VERDICT r2 #7: sort_keys/sort_values on interned mesh columns run
+    on device (rank surrogate) — no frame materialisation — and match
+    the lexicographic oracle."""
+    from gpu_mapreduce_tpu.parallel.sharded import ToHostStats
+
+    words = [b"pear", b"apple", b"fig", b"zoo", b"beta", b"kiwi",
+             b"mango", b"date", b"apple", b"fig"]
+    mr = MapReduce(make_mesh(4))
+    mr.map(1, lambda i, kv, p: [kv.add(w, np.uint64(j))
+                                for j, w in enumerate(words)])
+    mr.aggregate()
+    snap = ToHostStats.snapshot()
+    mr.sort_keys(5)
+    assert ToHostStats.delta(snap) == (0, 0)
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append(bytes(k)))
+    assert got == sorted(words)
+    snap = ToHostStats.snapshot()
+    mr.sort_keys(-5)
+    assert ToHostStats.delta(snap) == (0, 0)
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append(bytes(k)))
+    assert got == sorted(words, reverse=True)
+
+    # interned VALUES sort by bytes too, on device
+    mr2 = MapReduce(make_mesh(4))
+    mr2.map(1, lambda i, kv, p: [kv.add(np.uint64(j), w)
+                                 for j, w in enumerate(words)])
+    mr2.aggregate()
+    snap = ToHostStats.snapshot()
+    mr2.sort_values(5)
+    assert ToHostStats.delta(snap) == (0, 0)
+    got = []
+    mr2.scan_kv(lambda k, v, p: got.append(bytes(v)))
+    assert got == sorted(words)
+
+
+def test_one_sync_per_sharded_op(mesh):
+    """VERDICT r2 #8: each sharded MR op costs exactly ONE controller
+    round-trip — parity with the reference's one MPI_Allreduce per op
+    (src/mapreduce.cpp:557-558).  A composed collate (aggregate+convert)
+    therefore costs two, and a full composed-cc-style stage sequence
+    stays at one sync per stage."""
+    from gpu_mapreduce_tpu.parallel.sharded import SyncStats
+
+    mr = MapReduce(mesh)
+    mr.map(6, emit)
+
+    snap = SyncStats.snapshot()
+    mr.aggregate()
+    assert SyncStats.delta(snap) == 1, "aggregate != 1 sync"
+
+    snap = SyncStats.snapshot()
+    mr.convert()
+    assert SyncStats.delta(snap) == 1, "convert != 1 sync"
+
+    from gpu_mapreduce_tpu.oink.kernels import count
+    snap = SyncStats.snapshot()
+    mr.reduce(count, batch=True)
+    assert SyncStats.delta(snap) == 0, "batch reduce pulls mid-op"
+
+    # correctness unchanged
+    import collections
+    oracle = collections.Counter(k for k, v in oracle_pairs())
+    got = {}
+    mr.scan_kv(lambda k, v, p: got.__setitem__(int(k), int(v)))
+    assert got == dict(oracle)
