@@ -29,6 +29,12 @@ from ..oink.script import OinkScript
 
 _handles: Dict[int, object] = {}
 _next_id = [1]
+# mr handle → active BlockedMultivalue during a nvalues==0 reduce call
+_blockmeta: Dict[int, object] = {}
+# mr handle → block_rows threshold for the C reduce tier (the ONEMAX
+# stress hook, src/keymultivalue.cpp:43-45; set via
+# MR_set(mr, "c_block_rows", ...))
+_c_block_rows: Dict[int, int] = {}
 
 MAPTASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
                               ctypes.c_void_p)
@@ -104,13 +110,21 @@ def mr_create() -> int:
 
 def mr_destroy(h: int):
     _handles.pop(h, None)
+    _blockmeta.pop(h, None)
+    _c_block_rows.pop(h, None)
 
 
 def mr_copy(h: int) -> int:
-    return _register(_get(h).copy())
+    h2 = _register(_get(h).copy())
+    if h in _c_block_rows:      # MR_copy carries every setting over
+        _c_block_rows[h2] = _c_block_rows[h]
+    return h2
 
 
 def mr_set(h: int, name: str, value: str) -> int:
+    if name == "c_block_rows":
+        _c_block_rows[h] = int(value)
+        return 0
     mr = _get(h)
     mr.set(**{name: value if name == "fpath" else int(value)})
     return 0
@@ -228,16 +242,27 @@ def mr_scan_kmv(h: int, fnptr: int, appptr: int) -> int:
     return _get(h).scan_kmv(wrapper)
 
 
-def _call_reduce(fn, appptr, key, vals, kv):
+def _call_reduce(fn, appptr, key, vals, kv, mrh=None):
+    from ..core.frame import BlockedMultivalue
     kb = _to_bytes(key)
-    bvals = [_to_bytes(v) for v in vals]
-    mv = b"".join(bvals)
-    sizes = (ctypes.c_int * len(bvals))(*[len(b) for b in bvals])
     acc = _KVAccum(kv)
     kvh = _register(acc)
     try:
-        buf = ctypes.create_string_buffer(mv, len(mv))
-        fn(kb, len(kb), buf, len(bvals), sizes, kvh, appptr)
+        if isinstance(vals, BlockedMultivalue):
+            # the reference's multi-page signal: NULL multivalue +
+            # nvalues==0; the callback pulls blocks through
+            # MR_multivalue_blocks/_block (src/mapreduce.cpp:1874-1925)
+            _blockmeta[mrh] = vals
+            try:
+                fn(kb, len(kb), None, 0, None, kvh, appptr)
+            finally:
+                _blockmeta.pop(mrh, None)
+        else:
+            bvals = [_to_bytes(v) for v in vals]
+            mv = b"".join(bvals)
+            sizes = (ctypes.c_int * len(bvals))(*[len(b) for b in bvals])
+            buf = ctypes.create_string_buffer(mv, len(mv))
+            fn(kb, len(kb), buf, len(bvals), sizes, kvh, appptr)
         acc.flush()
     finally:
         _handles.pop(kvh, None)
@@ -247,14 +272,44 @@ def mr_reduce(h: int, fnptr: int, appptr: int) -> int:
     fn = REDUCE_FN(fnptr)
     mr = _get(h)
     return mr.reduce(lambda k, vals, kv, ptr:
-                     _call_reduce(fn, appptr, k, vals, kv))
+                     _call_reduce(fn, appptr, k, vals, kv, mrh=h),
+                     block_rows=_c_block_rows.get(h))
 
 
 def mr_compress(h: int, fnptr: int, appptr: int) -> int:
     fn = REDUCE_FN(fnptr)
     mr = _get(h)
     return mr.compress(lambda k, vals, kv, ptr:
-                       _call_reduce(fn, appptr, k, vals, kv))
+                       _call_reduce(fn, appptr, k, vals, kv, mrh=h),
+                       block_rows=_c_block_rows.get(h))
+
+
+def mr_multivalue_blocks(h: int) -> int:
+    """#blocks of the active nvalues==0 group (0 outside one)."""
+    bmv = _blockmeta.get(h)
+    if bmv is None:
+        return 0
+    return -(-bmv.nvalues_total // bmv.block_rows)
+
+
+def mr_multivalue_block(h: int, iblock: int):
+    """→ (nvalues, multivalue bytes, int32 LE valuesizes bytes) for block
+    ``iblock`` of the active group; the C shim pins the buffers until the
+    next block request (reference page-buffer lifetime)."""
+    bmv = _blockmeta.get(h)
+    if bmv is None:
+        raise RuntimeError("MR_multivalue_block outside a blocked reduce")
+    fr, i, br = bmv._frame, bmv._i, bmv.block_rows
+    start = int(fr.offsets[i]) + iblock * br
+    stop = min(start + br, int(fr.offsets[i + 1]))
+    if iblock < 0 or start >= int(fr.offsets[i + 1]):
+        raise IndexError(
+            f"block {iblock} out of range "
+            f"(group has {mr_multivalue_blocks(h)} blocks)")
+    col = fr.values.slice(start, stop)
+    bvals = [_to_bytes(v) for v in col.tolist()]
+    sizes = np.asarray([len(b) for b in bvals], np.int32)
+    return len(bvals), b"".join(bvals), sizes.tobytes()
 
 
 def mr_scan_kv(h: int, fnptr: int, appptr: int) -> int:
@@ -296,6 +351,40 @@ def mr_stats(h: int, which: str) -> int:
 
 def mr_print_file(h: int, path: str, kflag: int, vflag: int) -> int:
     return _get(h).print(kflag=kflag, vflag=vflag, file=path)
+
+
+def mr_print(h: int, nstride: int, kflag: int, vflag: int) -> int:
+    """Screen print (reference MR_print, src/cmapreduce.h)."""
+    return _get(h).print(nstride=nstride, kflag=kflag, vflag=vflag)
+
+
+def mr_cummulative_stats(h: int, level: int, reset: int) -> int:
+    _get(h).cummulative_stats(level, reset)
+    return 0
+
+
+def kv_add_multi_static(kvh: int, n: int, keyblob: bytes, keybytes: int,
+                        valblob: bytes, valuebytes: int):
+    """n pairs of FIXED-width keys/values packed back to back (reference
+    MR_kv_add_multi_static)."""
+    acc = _get(kvh)
+    for i in range(n):
+        acc.add(keyblob[i * keybytes:(i + 1) * keybytes],
+                valblob[i * valuebytes:(i + 1) * valuebytes])
+
+
+def kv_add_multi_dynamic(kvh: int, n: int, keyblob: bytes,
+                         keysizes: bytes, valblob: bytes,
+                         valsizes: bytes):
+    """n pairs of VARIABLE-width keys/values; per-pair byte counts arrive
+    as int32 arrays (reference MR_kv_add_multi_dynamic)."""
+    acc = _get(kvh)
+    ks = np.frombuffer(keysizes, np.int32, n)
+    vs = np.frombuffer(valsizes, np.int32, n)
+    ko = np.concatenate([[0], np.cumsum(ks)])
+    vo = np.concatenate([[0], np.cumsum(vs)])
+    for i in range(n):
+        acc.add(keyblob[ko[i]:ko[i + 1]], valblob[vo[i]:vo[i + 1]])
 
 
 # -- OINK script driver (reference oink/library.h mrmpi_open/...) ----------

@@ -69,8 +69,13 @@ static PyObject *bridge_call(const char *method, const char *fmt, ...) {
   return result;
 }
 
+/* Every Python-object touch needs the GIL: MR_* functions are legal
+ * INSIDE map/reduce callbacks (MR_kv_add, MR_multivalue_blocks...),
+ * where ctypes released the GIL before entering the C callback — a
+ * GIL-less PyErr_Occurred there dereferences a NULL thread state. */
 static uint64_t as_u64(PyObject *r) {
   if (r == NULL) return 0;
+  PyGILState_STATE g = PyGILState_Ensure();
   uint64_t v = 0;
   if (r != Py_None) v = (uint64_t)PyLong_AsUnsignedLongLong(r);
   if (PyErr_Occurred()) {
@@ -78,7 +83,15 @@ static uint64_t as_u64(PyObject *r) {
     v = 0;
   }
   Py_DECREF(r);
+  PyGILState_Release(g);
   return v;
+}
+
+static void drop(PyObject *r) {
+  if (r == NULL) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_DECREF(r);
+  PyGILState_Release(g);
 }
 
 /* ------------------------------------------------------------------ */
@@ -106,7 +119,7 @@ void *MR_create(void) {
 }
 
 void MR_destroy(void *mr) {
-  Py_XDECREF(bridge_call("mr_destroy", "(n)", (Py_ssize_t)mr));
+  drop(bridge_call("mr_destroy", "(n)", (Py_ssize_t)mr));
 }
 
 void *MR_copy(void *mr) {
@@ -123,7 +136,7 @@ int MR_set(void *mr, const char *name, const char *value) {
 
 void MR_kv_add(void *kv, const char *key, int keybytes, const char *value,
                int valuebytes) {
-  Py_XDECREF(bridge_call("kv_add", "(ny#y#)", (Py_ssize_t)kv, key,
+  drop(bridge_call("kv_add", "(ny#y#)", (Py_ssize_t)kv, key,
                          (Py_ssize_t)keybytes, value,
                          (Py_ssize_t)valuebytes));
 }
@@ -140,18 +153,27 @@ uint64_t MR_map(void *mr, int nmap, void (*mymap)(int, void *, void *),
   return MR_map_add(mr, nmap, mymap, ptr, 0);
 }
 
+static PyObject *path_list(int nstr, char **paths) {
+  /* GIL-safe: map-from-a-callback is legal (the doc promises it) */
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject *list = PyList_New(nstr);
+  if (list != NULL)
+    for (int i = 0; i < nstr; i++)
+      PyList_SET_ITEM(list, i, PyBytes_FromString(paths[i]));
+  PyGILState_Release(g);
+  return list;
+}
+
 uint64_t MR_map_file_list(void *mr, int nstr, char **paths,
                           void (*mymap)(int, char *, void *, void *),
                           void *ptr) {
-  PyObject *list = PyList_New(nstr);
+  PyObject *list = path_list(nstr, paths);
   if (list == NULL) return 0;
-  for (int i = 0; i < nstr; i++)
-    PyList_SET_ITEM(list, i, PyBytes_FromString(paths[i]));
   uint64_t n = as_u64(bridge_call("mr_map_file_list", "(nOnni)",
                                   (Py_ssize_t)mr, list,
                                   (Py_ssize_t)(intptr_t)mymap,
                                   (Py_ssize_t)(intptr_t)ptr, 0));
-  Py_DECREF(list);
+  drop(list);
   return n;
 }
 
@@ -160,16 +182,14 @@ static uint64_t map_chunks(void *mr, const char *which, int nmap, int nstr,
                            int delta, void (*fn)(int, char *, int, void *,
                                                  void *),
                            void *ptr) {
-  PyObject *list = PyList_New(nstr);
+  PyObject *list = path_list(nstr, paths);
   if (list == NULL) return 0;
-  for (int i = 0; i < nstr; i++)
-    PyList_SET_ITEM(list, i, PyBytes_FromString(paths[i]));
   uint64_t n = as_u64(bridge_call("mr_map_file_chunks", "(nsiOy#inn)",
                                   (Py_ssize_t)mr, which, nmap, list, sep,
                                   (Py_ssize_t)seplen, delta,
                                   (Py_ssize_t)(intptr_t)fn,
                                   (Py_ssize_t)(intptr_t)ptr));
-  Py_DECREF(list);
+  drop(list);
   return n;
 }
 
@@ -249,6 +269,17 @@ uint64_t MR_add(void *mr, void *mr2) {
                             "add", (Py_ssize_t)mr2));
 }
 
+uint64_t MR_scrunch(void *mr, int nprocs, const char *key, int keybytes) {
+  return as_u64(bridge_call("mr_method_u64", "(nsiy#)", (Py_ssize_t)mr,
+                            "scrunch", nprocs, key, (Py_ssize_t)keybytes));
+}
+
+void MR_open(void *mr) {
+  drop(bridge_call("mr_method_u64", "(ns)", (Py_ssize_t)mr, "open"));
+}
+
+uint64_t MR_close(void *mr) { return method0(mr, "close"); }
+
 uint64_t MR_sort_keys_flag(void *mr, int flag) {
   return as_u64(bridge_call("mr_method_u64", "(nsi)", (Py_ssize_t)mr,
                             "sort_keys", flag));
@@ -307,6 +338,69 @@ int MR_print_file(void *mr, const char *path, int kflag, int vflag) {
   return 0;
 }
 
+uint64_t MR_print(void *mr, int nstride, int kflag, int vflag) {
+  return as_u64(bridge_call("mr_print", "(niii)", (Py_ssize_t)mr, nstride,
+                            kflag, vflag));
+}
+
+void MR_cummulative_stats(void *mr, int level, int reset) {
+  drop(bridge_call("mr_cummulative_stats", "(nii)", (Py_ssize_t)mr,
+                         level, reset));
+}
+
+void MR_kv_add_multi_static(void *kv, int n, const char *key, int keybytes,
+                            const char *value, int valuebytes) {
+  drop(bridge_call(
+      "kv_add_multi_static", "(niy#iy#i)", (Py_ssize_t)kv, n, key,
+      (Py_ssize_t)((Py_ssize_t)n * keybytes), keybytes, value,
+      (Py_ssize_t)((Py_ssize_t)n * valuebytes), valuebytes));
+}
+
+void MR_kv_add_multi_dynamic(void *kv, int n, const char *key,
+                             const int *keybytes, const char *value,
+                             const int *valuebytes) {
+  Py_ssize_t tk = 0, tv = 0;
+  for (int i = 0; i < n; i++) {
+    tk += keybytes[i];
+    tv += valuebytes[i];
+  }
+  drop(bridge_call(
+      "kv_add_multi_dynamic", "(niy#y#y#y#)", (Py_ssize_t)kv, n, key, tk,
+      (const char *)keybytes, (Py_ssize_t)(n * (Py_ssize_t)sizeof(int)),
+      value, tv, (const char *)valuebytes,
+      (Py_ssize_t)(n * (Py_ssize_t)sizeof(int))));
+}
+
+/* multi-block multivalue API: the bridge returns (nval, mv, sizes); the
+ * buffers stay pinned here until the next block request (reference
+ * page-buffer lifetime, src/mapreduce.cpp:1874-1925) */
+static PyObject *blk_hold = NULL;
+
+uint64_t MR_multivalue_blocks(void *mr) {
+  return as_u64(
+      bridge_call("mr_multivalue_blocks", "(n)", (Py_ssize_t)mr));
+}
+
+int MR_multivalue_block(void *mr, int iblock, char **ptr_multivalue,
+                        int **ptr_valuesizes) {
+  PyObject *r =
+      bridge_call("mr_multivalue_block", "(ni)", (Py_ssize_t)mr, iblock);
+  if (r == NULL) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_XDECREF(blk_hold);
+  blk_hold = r; /* keeps mv + sizes bytes alive */
+  long nval = PyLong_AsLong(PyTuple_GetItem(r, 0));
+  *ptr_multivalue = PyBytes_AsString(PyTuple_GetItem(r, 1));
+  *ptr_valuesizes = (int *)PyBytes_AsString(PyTuple_GetItem(r, 2));
+  PyGILState_Release(g);
+  return (int)nval;
+}
+
+void MR_multivalue_block_select(void *mr, int which) {
+  (void)mr;
+  (void)which; /* reference 2-page scratch selector; no-op here */
+}
+
 /* -- OINK script driver -------------------------------------------- */
 
 void *OINK_open(const char *logfile) {
@@ -333,5 +427,5 @@ int OINK_command(void *oink, const char *line) {
 }
 
 void OINK_close(void *oink) {
-  Py_XDECREF(bridge_call("oink_close", "(n)", (Py_ssize_t)oink));
+  drop(bridge_call("oink_close", "(n)", (Py_ssize_t)oink));
 }

@@ -33,6 +33,15 @@ int MR_set(void *mr, const char *name, const char *value);
 /* pair adds — valid only on the KV handle passed into a callback */
 void MR_kv_add(void *kv, const char *key, int keybytes,
                const char *value, int valuebytes);
+/* n fixed-width pairs packed back to back (reference
+ * MR_kv_add_multi_static) */
+void MR_kv_add_multi_static(void *kv, int n, const char *key, int keybytes,
+                            const char *value, int valuebytes);
+/* n variable-width pairs; keybytes/valuebytes are per-pair size arrays
+ * (reference MR_kv_add_multi_dynamic) */
+void MR_kv_add_multi_dynamic(void *kv, int n, const char *key,
+                             const int *keybytes, const char *value,
+                             const int *valuebytes);
 
 /* map */
 uint64_t MR_map(void *mr, int nmap,
@@ -72,6 +81,12 @@ uint64_t MR_collapse(void *mr, const char *key, int keybytes);
 uint64_t MR_gather(void *mr, int nprocs);
 uint64_t MR_broadcast(void *mr, int root);
 uint64_t MR_add(void *mr, void *mr2);
+/* gather to nprocs + collapse under one key (reference MR_scrunch) */
+uint64_t MR_scrunch(void *mr, int nprocs, const char *key, int keybytes);
+/* cross-MR add state: open() lets later maps/reduces add into this MR's
+ * KV; close() completes it (reference MR_open/MR_close) */
+void MR_open(void *mr);
+uint64_t MR_close(void *mr);
 uint64_t MR_reduce(void *mr,
                    void (*myreduce)(char *key, int keybytes,
                                     char *multivalue, int nvalues,
@@ -106,7 +121,23 @@ uint64_t MR_scan_kmv(void *mr,
                      void *ptr);
 uint64_t MR_kv_stats(void *mr);
 uint64_t MR_kmv_stats(void *mr);
+void MR_cummulative_stats(void *mr, int level, int reset);
 int MR_print_file(void *mr, const char *path, int kflag, int vflag);
+uint64_t MR_print(void *mr, int nstride, int kflag, int vflag);
+
+/* multi-block ("extended") multivalues: a reduce callback that receives
+ * multivalue==NULL and nvalues==0 iterates the group in blocks —
+ * MR_multivalue_blocks() gives the block count, MR_multivalue_block()
+ * loads block iblock and returns its value count (buffers stay valid
+ * until the next block request); _block_select is accepted for
+ * reference parity and is a no-op (no 2-page scratch here).  Enable
+ * blocking with MR_set(mr, "c_block_rows", "<rows>") — groups larger
+ * than that arrive blocked (the reference blocks when a group outgrows
+ * a page; src/mapreduce.cpp:1874-1925). */
+uint64_t MR_multivalue_blocks(void *mr);
+int MR_multivalue_block(void *mr, int iblock, char **ptr_multivalue,
+                        int **ptr_valuesizes);
+void MR_multivalue_block_select(void *mr, int which);
 
 /* OINK script driver (reference oink/library.h mrmpi_open/file/command/
  * close) */

@@ -595,11 +595,12 @@ class MapReduce:
             else:
                 func(k, fr.group_values(i).tolist(), kv, ptr)
 
-    def compress(self, func: Callable, ptr=None, batch: bool = False) -> int:
+    def compress(self, func: Callable, ptr=None, batch: bool = False,
+                 block_rows: Optional[int] = None) -> int:
         """Local convert + reduce, KV→KV — the combiner (reference
-        src/mapreduce.cpp:749-851)."""
+        src/mapreduce.cpp:749-851).  ``block_rows`` as in :meth:`reduce`."""
         self.convert()
-        return self.reduce(func, ptr, batch=batch)
+        return self.reduce(func, ptr, batch=batch, block_rows=block_rows)
 
     # ------------------------------------------------------------------
     # scan / print (read-only)
