@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..ops.hash import hash_bytes64, hash_bytes64_batch
+from ..ops.hash import hash_bytes64_batch
 
 ArrayLike = Union[np.ndarray, jax.Array]
 
@@ -142,20 +142,66 @@ class BytesColumn(Column):
     def intern(self) -> tuple:
         """Map byte strings to u64 ids for device-side shuffling/grouping.
 
-        Returns ``(DenseColumn[uint64], {id: bytes})``.  Raises on a 64-bit
-        collision between distinct strings (probability ~n^2/2^64)."""
+        Returns ``(DenseColumn[uint64], {id: bytes})``.  All-vectorised:
+        native batch hash of every row, numeric unique for the table,
+        and — only when duplicate ids exist — an independent second hash
+        family detects collisions (one id, two alts), the same standard
+        the device tier uses (apps/invertedindex).  The former per-row
+        Python dict loop was the aggregate hot spot on heavy-repetition
+        columns (wordfreq tokens)."""
         strings = [bytes(s) for s in self.data]
-        ids = hash_bytes64_batch(strings)
-        table = InternTable(kind="bytes")
-        for h, s in zip(ids.tolist(), strings):
-            prev = table.get(h)
-            if prev is not None and prev != s:
-                raise ValueError("64-bit intern collision between %r and %r" % (prev, s))
-            table[h] = s
+        ids, table = _intern_ids(strings, strings, "bytes")
         return DenseColumn(ids), table
 
     def __repr__(self):
         return f"BytesColumn<n={len(self)}>"
+
+
+def _intern_ids(strings, rows, kind: str):
+    """Shared vectorised intern core: hash ``strings`` (the per-row
+    bytes), build the id→``rows[i]`` table from the first occurrence of
+    each unique id, and — when duplicate ids exist — verify them with
+    an independent second hash family (same id + different alt = a real
+    collision; both families agreeing on distinct inputs is ~2^-128,
+    the device tier's standard, apps/invertedindex).  The byte buffer
+    packs ONCE for both families.  Returns (ids uint64[n], InternTable);
+    the former per-row Python dict loop was the aggregate hot spot."""
+    from .. import native
+    if not len(strings):
+        return np.zeros(0, np.uint64), InternTable(kind=kind)
+    if native.available():
+        lens = np.fromiter((len(s) for s in strings), np.int64,
+                           count=len(strings))
+        offs = np.zeros(len(strings) + 1, np.int64)
+        np.cumsum(lens, out=offs[1:])
+        buf = b"".join(strings)
+        ids = native.intern64_batch(buf, offs)
+        alt = lambda: native.intern_ranges(buf, offs[:-1], lens,
+                                           0x9E3779B9, 0x85EBCA6B)
+    else:
+        ids = hash_bytes64_batch(strings)
+        alt = lambda: hash_bytes64_batch(strings, 0x9E3779B9, 0x85EBCA6B)
+    # ONE stable sort yields unique ids, first-occurrence rows AND the
+    # adjacency layout the collision check needs (np.unique would be a
+    # second full sort on this hot path)
+    order = np.argsort(ids, kind="stable")
+    si = ids[order]
+    head = np.ones(len(si), bool)
+    head[1:] = si[1:] != si[:-1]
+    if not head.all():
+        alts = alt()
+        sa = alts[order]
+        # no collision ⇒ every row of an id shares one alt; a collision
+        # puts ≥2 alt values in some id run ⇒ some adjacent pair differs
+        bad = ~head[1:] & (sa[1:] != sa[:-1])
+        if bad.any():
+            i = int(np.nonzero(bad)[0][0])
+            raise ValueError(
+                "64-bit intern collision between %r and %r"
+                % (strings[order[i]], strings[order[i + 1]]))
+    table = InternTable(((int(h), rows[int(i)]) for h, i in
+                         zip(si[head], order[head])), kind=kind)
+    return ids, table
 
 
 class InternTable(dict):
@@ -218,16 +264,8 @@ class ObjectColumn(Column):
     def intern(self) -> tuple:
         """Objects → u64 ids via their pickles (see BytesColumn.intern);
         the id→object table stays controller-side."""
-        pk = self.pickles()
-        ids = hash_bytes64_batch(pk)
-        table = InternTable(kind="object")
-        seen: Dict[int, bytes] = {}
-        for h, p, obj in zip(ids.tolist(), pk, self.data):
-            prev = seen.get(h)
-            if prev is not None and prev != p:
-                raise ValueError("64-bit intern collision between objects")
-            seen[h] = p
-            table[h] = obj
+        ids, table = _intern_ids(self.pickles(), self.data.tolist(),
+                                 "object")
         return DenseColumn(ids), table
 
     def __repr__(self):

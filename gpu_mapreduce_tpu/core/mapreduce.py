@@ -878,6 +878,7 @@ class MapReduce:
                   f"{c.wsize / (1 << 20):.3g} Mb written")
             print(f"Cummulative comm = {c.cssize / (1 << 20):.3g} Mb sent, "
                   f"{c.crsize / (1 << 20):.3g} Mb received, "
+                  f"{c.cspad / (1 << 20):.3g} Mb padding, "
                   f"{c.commtime:.3g} secs")
         if reset:
             c.__init__()

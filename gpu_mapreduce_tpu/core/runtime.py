@@ -91,8 +91,11 @@ class Counters:
     msizemax: int = 0       # hi-water
     rsize: int = 0          # bytes read from spill files
     wsize: int = 0          # bytes written to spill files
-    cssize: int = 0         # bytes sent in shuffles
-    crsize: int = 0         # bytes received in shuffles
+    cssize: int = 0         # useful bytes sent in shuffles
+    crsize: int = 0         # useful bytes received in shuffles
+    cspad: int = 0          # PADDING bytes sent (static-shape exchange
+    #                         slack: [P,B]-buckets minus real rows —
+    #                         the weak-scaling "network volume" diagnosis)
     commtime: float = 0.0   # seconds in collectives
 
     def __post_init__(self):

@@ -98,19 +98,26 @@ def hash_bytes64(data: bytes) -> int:
     return (hi << 32) | lo
 
 
-def hash_bytes64_batch(strings) -> np.ndarray:
+def hash_bytes64_batch(strings, seed_hi: int = 0,
+                       seed_lo: int = 0xDEADBEEF) -> np.ndarray:
     """Vector hash_bytes64 over a sequence of byte strings — routed
     through the native C++ runtime when built (the reference's host
     hashing is C++, src/hash.cpp; our interning loops were the last
-    per-item Python hot paths)."""
+    per-item Python hot paths).  Non-default seeds give an independent
+    id family (the intern collision check)."""
     from .. import native
     if native.available() and len(strings):
         lens = np.fromiter((len(s) for s in strings), np.int64,
                            count=len(strings))
         offs = np.zeros(len(strings) + 1, np.int64)
         np.cumsum(lens, out=offs[1:])
-        return native.intern64_batch(b"".join(strings), offs)
-    return np.array([hash_bytes64(s) for s in strings], np.uint64)
+        buf = b"".join(strings)
+        if (seed_hi, seed_lo) == (0, 0xDEADBEEF):
+            return native.intern64_batch(buf, offs)
+        return native.intern_ranges(buf, offs[:-1], lens, seed_hi, seed_lo)
+    return np.array([(np.uint64(hashlittle(s, seed_hi)) << np.uint64(32))
+                     | np.uint64(hashlittle(s, seed_lo))
+                     for s in strings], np.uint64)
 
 
 # ---------------------------------------------------------------------------

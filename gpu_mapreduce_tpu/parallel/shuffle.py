@@ -313,8 +313,16 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
     if counters is not None:
         rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
                     skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
-        moved = int(counts_mat.sum() - np.trace(counts_mat)) * rowbytes
-        counters.add(cssize=moved, crsize=moved)
+        useful = int(counts_mat.sum() - np.trace(counts_mat))
+        moved = useful * rowbytes
+        # padding diagnosis (VERDICT r2 #5): the exchange physically
+        # moves nrounds × [P,B] buckets per shard; the slack beyond the
+        # real rows is pure padding volume.  Diagonal (self→self) slots
+        # never cross the interconnect — excluded on BOTH sides so pad
+        # is directly comparable to cssize
+        sent_slots = nprocs * (nprocs - 1) * B * nrounds
+        pad = max(0, sent_slots - useful) * rowbytes
+        counters.add(cssize=moved, crsize=moved, cspad=pad)
     return ShardedKV(mesh, out_k, out_v, new_counts,
                      key_decode=skv.key_decode,
                      value_decode=skv.value_decode)

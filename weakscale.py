@@ -50,21 +50,31 @@ def main():
     rows = []
     with tempfile.TemporaryDirectory() as tmpdir:
         files = make_files(tmpdir, max(sizes), mb_per_proc)
-        for P in sizes:
+
+        def run(P, counters=None):
             mr = MapReduce(make_mesh(P))
             stages = {}
             t = Timer()
             mr.map_files(files[:P], read_words)
             stages["map"] = t.elapsed()
+            snap = counters.cspad if counters else 0
             t = Timer()
             mr.aggregate()          # the "network I/O" stage
             stages["aggregate"] = t.elapsed()
+            if counters:
+                stages["pad_mb"] = (counters.cspad - snap) / (1 << 20)
             t = Timer()
             mr.convert()
             stages["convert"] = t.elapsed()
             t = Timer()
             n = mr.reduce(count, batch=True)
             stages["reduce"] = t.elapsed()
+            return n, stages
+
+        from gpu_mapreduce_tpu.core.runtime import global_counters
+        for P in sizes:
+            run(P)                       # pay the per-mesh XLA compiles
+            n, stages = run(P, global_counters())   # steady state
             rows.append({"nprocs": P, "nunique": int(n),
                          **{k: round(v, 3) for k, v in stages.items()}})
             print(json.dumps(rows[-1]))
