@@ -107,18 +107,37 @@ def probe_backend(timeout: float, retries: int = 3):
     return None, err
 
 
-def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4):
-    """Deterministic synthetic HTML: filler with a URL every ~1KB."""
+def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4,
+                skew: bool = False):
+    """Deterministic synthetic HTML: filler with a URL every ~1KB.
+
+    ``skew`` (BENCH_SKEW=1, VERDICT r2 #9): ~25% of references hit a
+    64-URL hot set (RMAT-hub-style shuffle skew) and ~2% are 120–200
+    byte long-tail URLs (drives the two-tier window's second gather).
+    Returns (paths, total refs, unique urls)."""
     per_file = (total_mb << 20) // nfiles
     filler = b"<p>" + b"lorem ipsum dolor sit amet " * 36 + b"</p>\n"  # ~1KB
+    hot = [b"http://example.org/hot/%02d" % i for i in range(64)]
     paths = []
     uid = 0
+    nref = 0
+    uniq = set()
     for i in range(nfiles):
         pieces = []
         size = 0
         while size < per_file:
-            url = b'<a href="http://example.org/wiki/page-%08d">x</a>' % uid
-            uid += 1
+            if skew and nref % 4 == 3:
+                u = hot[(nref // 4) % len(hot)]
+            elif skew and nref % 50 == 49:
+                u = (b"http://example.org/long/"
+                     + b"p%08d/" % uid + b"x" * (96 + uid % 80))
+                uid += 1
+            else:
+                u = b"http://example.org/wiki/page-%08d" % uid
+                uid += 1
+            url = b'<a href="' + u + b'">x</a>'
+            uniq.add(u)
+            nref += 1
             pieces.append(filler)
             pieces.append(url)
             size += len(filler) + len(url)
@@ -126,11 +145,12 @@ def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4):
         with open(path, "wb") as f:
             f.write(b"".join(pieces))
         paths.append(path)
-    return paths, uid
+    return paths, nref, len(uniq)
 
 
 def run_bench(engine, backend_err):
     total_mb = int(os.environ.get("BENCH_MB", "256"))
+    skew = os.environ.get("BENCH_SKEW", "0") == "1"
     import jax
     jax.config.update("jax_enable_x64", True)  # u64 url ids on device
     enable_compilation_cache()
@@ -142,7 +162,7 @@ def run_bench(engine, backend_err):
         comm = make_mesh(1)  # 1-chip mesh: KV stays device-resident
 
     with tempfile.TemporaryDirectory() as tmpdir:
-        paths, nurls = make_corpus(tmpdir, total_mb)
+        paths, nurls, nuniq = make_corpus(tmpdir, total_mb, skew=skew)
         nbytes = sum(os.path.getsize(p) for p in paths)
 
         # warmup at FULL shapes so the timed run measures steady state
@@ -157,6 +177,7 @@ def run_bench(engine, backend_err):
         dt = time.perf_counter() - t0
 
     assert npairs == nurls, (npairs, nurls)
+    assert nunique == nuniq, (nunique, nuniq)
     raw = idx.timer.times
     stages = {k: round(v, 4) for k, v in sorted(raw.items())}
     # the map stage over the reference's 44 ms boundary (see docstring);
@@ -176,12 +197,16 @@ def run_bench(engine, backend_err):
     map_bytes_per_sec = nbytes / map_time
     detail = {
         "npairs": npairs, "nunique": nunique, "bytes": nbytes,
+        "corpus": {"mb": total_mb, "skew": skew},
         "map_stage_sec": round(map_time, 4),
         "map_stage_bytes_per_sec": round(map_bytes_per_sec, 1),
         "end_to_end_sec": round(dt, 3),
         "end_to_end_bytes_per_sec": round(nbytes / dt, 1),
         "backend": jax.default_backend(), "engine": idx.engine,
         "stages_sec": stages,
+        # device-tier batching + two-tier window machinery (VERDICT r2
+        # #9: the recorded detail must show these exercised at volume)
+        "map_stats": getattr(idx, "stats", {}),
     }
     try:
         print(json.dumps({"detail": detail}), file=sys.stderr)

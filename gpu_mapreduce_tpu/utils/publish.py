@@ -1,0 +1,14 @@
+"""Shared BASELINE.json publishing — one implementation of the
+read/merge/write pattern soak.py, weakscale.py and record_scale.py
+each hand-rolled (backend-qualified keys so no harness clobbers
+another's records)."""
+
+import json
+
+
+def publish(key: str, record, path: str = "BASELINE.json") -> None:
+    with open(path) as f:
+        base = json.load(f)
+    base.setdefault("published", {})[key] = record
+    with open(path, "w") as f:
+        json.dump(base, f, indent=2)

@@ -1,0 +1,41 @@
+"""Fold a bench.py scale-config capture into BASELINE.json.
+
+Usage: python scripts/record_scale.py <stdout-file> <stderr-file> [key]
+Reads the metric line from stdout and the {"detail": ...} line from
+stderr, merges them under BASELINE.json published.<key> (default
+bench_tpu_scale).  Used by scripts/tpu_watch.sh after the primary
+TPU capture succeeds (VERDICT r2 #9: record the multi-batch + skewed
+corpus shape at volume)."""
+
+import json
+import sys
+
+
+def main():
+    out_path, err_path = sys.argv[1], sys.argv[2]
+    key = sys.argv[3] if len(sys.argv) > 3 else "bench_tpu_scale"
+    metric = None
+    for line in open(out_path):
+        line = line.strip()
+        if line.startswith("{"):
+            metric = json.loads(line)
+    detail = None
+    for line in open(err_path):
+        line = line.strip()
+        if line.startswith("{") and '"detail"' in line:
+            detail = json.loads(line)["detail"]
+    if metric is None:
+        raise SystemExit("no metric line found")
+    rec = dict(metric)
+    if detail is not None:
+        rec["detail"] = detail
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from gpu_mapreduce_tpu.utils.publish import publish
+    publish(key, rec)
+    print(f"recorded published.{key}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,6 +25,19 @@ while true; do
         cp /tmp/bench_tpu.out /tmp/bench_tpu.captured
       fi
     fi
+    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
+      # the at-volume corpus shape (VERDICT r2 #9): multi-batch (2 GiB
+      # > the 1 GiB int32 batch cap) + skewed keys + long-URL tail
+      BENCH_MB=2048 BENCH_SKEW=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
+        timeout 5400 python bench.py >/tmp/bench_tpu_scale.out 2>/tmp/bench_tpu_scale.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) bench-scale rc=$rc $(cat /tmp/bench_tpu_scale.out)" >>"$LOG"
+      if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_scale.out; then
+        if python scripts/record_scale.py /tmp/bench_tpu_scale.out /tmp/bench_tpu_scale.err >>"$LOG" 2>&1; then
+          touch /tmp/bench_scale_done
+        fi
+      fi
+    fi
     if [ "$SOAK_OK" = 0 ]; then
       SOAK_SCALE="${SOAK_SCALE:-20}" \
         timeout 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
@@ -34,9 +47,9 @@ while true; do
         SOAK_OK=1
       fi
     fi
-    if [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ]; then
+    if [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ] && [ -f /tmp/bench_scale_done ]; then
       touch /tmp/tpu_captured.flag
-      echo "$(date -u +%FT%TZ) both records captured on TPU" >>"$LOG"
+      echo "$(date -u +%FT%TZ) all records captured on TPU" >>"$LOG"
       exit 0
     fi
   fi

@@ -156,14 +156,10 @@ def main():
     published["rmat_scale"] = scale
     published["nedges"] = nedges
 
-    with open("BASELINE.json") as f:
-        base = json.load(f)
-    # merge under a backend-qualified key — never wipe records other
-    # harnesses own (bench.py's invertedindex numbers) and never let a
-    # CPU re-run clobber a previous real-TPU soak
-    base.setdefault("published", {})[f"soak_{backend}"] = published
-    with open("BASELINE.json", "w") as f:
-        json.dump(base, f, indent=2)
+    # backend-qualified key — never wipe records other harnesses own
+    # and never let a CPU re-run clobber a previous real-TPU soak
+    from gpu_mapreduce_tpu.utils.publish import publish
+    publish(f"soak_{backend}", published)
     print("BASELINE.json published:", json.dumps(published))
 
 

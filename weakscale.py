@@ -83,12 +83,8 @@ def main():
     print(json.dumps(record))
     # persist like soak.py: backend-qualified, never clobbering others
     try:
-        with open("BASELINE.json") as f:
-            base = json.load(f)
-        base.setdefault("published", {})[
-            f"weakscale_{record['backend']}"] = record
-        with open("BASELINE.json", "w") as f:
-            json.dump(base, f, indent=2)
+        from gpu_mapreduce_tpu.utils.publish import publish
+        publish(f"weakscale_{record['backend']}", record)
     except FileNotFoundError:
         pass
 
