@@ -126,12 +126,12 @@ def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4,
         pieces = []
         size = 0
         while size < per_file:
-            if skew and nref % 4 == 3:
-                u = hot[(nref // 4) % len(hot)]
-            elif skew and nref % 50 == 49:
+            if skew and nref % 50 == 49:   # checked first: ~2% long tail
                 u = (b"http://example.org/long/"
                      + b"p%08d/" % uid + b"x" * (96 + uid % 80))
                 uid += 1
+            elif skew and nref % 4 == 3:
+                u = hot[(nref // 4) % len(hot)]
             else:
                 u = b"http://example.org/wiki/page-%08d" % uid
                 uid += 1

@@ -440,9 +440,12 @@ class InvertedIndex:
         # bounded by the UNIQUE url count on exactly the large-corpus
         # path (ADVICE r2); see _fold_id_check
         self._chk_runs: List[tuple] = []
+        self._reset_stats()
+
+    def _reset_stats(self):
         # map-stage machinery counters, surfaced by bench.py's detail
         # record (VERDICT r2 #9): batches processed, hit-capacity
-        # retries, wide-window fallbacks, largest long-tail overflow
+        # retries, wide-window fallbacks, largest RAW long-tail count
         self.stats = {"nbatches": 0, "cap_retries": 0,
                       "wide_fallbacks": 0, "nlong_max": 0}
 
@@ -765,8 +768,7 @@ class InvertedIndex:
         cuda/InvertedIndex.cu:463-513)."""
         mr = MapReduce(self.comm, mapstyle=self.mapstyle)
         self._mr = mr
-        self.stats = {"nbatches": 0, "cap_retries": 0,
-                      "wide_fallbacks": 0, "nlong_max": 0}
+        self._reset_stats()
         files = findfiles(list(paths))
         if nfiles is not None:
             files = files[:nfiles]
