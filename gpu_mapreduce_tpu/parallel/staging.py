@@ -128,11 +128,16 @@ def _unique_fn(mesh, nrows: int, drop_self: bool):
         first = jnp.concatenate([jnp.ones(1, bool), s[1:] != s[:-1]])
         isu = first & (s != SENTINEL)
         n = jnp.sum(isu.astype(jnp.int64))
-        order = jnp.argsort(~isu, stable=True)   # uniques first, sorted
-        verts = jnp.take(s, order)
-        # tail rows past n are leftover duplicates — overwrite with the
-        # sentinel so the table stays globally sorted for searchsorted
-        verts = jnp.where(jnp.arange(verts.shape[0]) < n, verts, SENTINEL)
+        # compact uniques to the front with prefix-sum + scatter-drop
+        # (positions unique by construction) — ~20× cheaper than a
+        # second sort; the sentinel fill keeps the table globally
+        # sorted for searchsorted
+        m = s.shape[0]
+        # int64 positions: at pod scale the flattened endpoints can
+        # exceed 2^31 rows and an i32 cumsum would wrap (silent drop)
+        pos = jnp.cumsum(isu.astype(jnp.int64)) - 1
+        tgt = jnp.where(isu, pos, m)
+        verts = jnp.full(m, SENTINEL).at[tgt].set(s, mode="drop")
         return verts, n, nbad
 
     return run

@@ -4,9 +4,17 @@ each hand-rolled (backend-qualified keys so no harness clobbers
 another's records)."""
 
 import json
+import os
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
-def publish(key: str, record, path: str = "BASELINE.json") -> None:
+def publish(key: str, record, path: str = None) -> None:
+    """Merge ``record`` under published.<key> of the REPO's
+    BASELINE.json (cwd-independent by default)."""
+    if path is None:
+        path = os.path.join(_ROOT, "BASELINE.json")
     with open(path) as f:
         base = json.load(f)
     base.setdefault("published", {})[key] = record

@@ -155,6 +155,13 @@ def main():
     published["backend"] = backend
     published["rmat_scale"] = scale
     published["nedges"] = nedges
+    published["notes"] = (
+        "round 3: cc_find times INCLUDE device-side staging (mesh "
+        "vertex ranking, parallel/staging.py) where round 2 staged on "
+        "the controller with np.unique — slower on the 1-device CPU "
+        "fake (single-core XLA sort) but removes the controller funnel "
+        "the mesh cannot outgrow; compare cc rows across rounds with "
+        "that in mind")
 
     # backend-qualified key — never wipe records other harnesses own
     # and never let a CPU re-run clobber a previous real-TPU soak
