@@ -5,12 +5,13 @@ another's records)."""
 
 import json
 import os
+from typing import Optional
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def publish(key: str, record, path: str = None) -> None:
+def publish(key: str, record, path: Optional[str] = None) -> None:
     """Merge ``record`` under published.<key> of the REPO's
     BASELINE.json (cwd-independent by default)."""
     if path is None:

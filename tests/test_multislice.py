@@ -91,3 +91,22 @@ def test_gather_and_broadcast_on_multislice(rng):
     mr.broadcast(0)
     fr = mr.kv.one_frame()
     assert all(int(c) == int(fr.counts[0]) for c in fr.counts)
+
+
+def test_spmd_ingestion_on_multislice_mesh(tmp_path):
+    """Mesh-SPMD InvertedIndex ingestion over a (slice, chip) mesh: the
+    per-device corpus placement and shard_map extract run on 2-axis
+    meshes identically to flat ones."""
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+
+    paths = []
+    for i in range(8):
+        p = tmp_path / f"f{i}.html"
+        p.write_bytes(b'<a href="http://s%d.org/p">x</a>fill' % (i % 3) * 5)
+        paths.append(str(p))
+    ii1 = InvertedIndex()
+    n1 = ii1.run(paths)
+    ii2 = InvertedIndex(comm=make_mesh2(2, 4))
+    n2 = ii2.run(paths)
+    assert n1 == n2
+    assert ii1.urls == ii2.urls

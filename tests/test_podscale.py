@@ -54,3 +54,59 @@ def test_exchange_compiles_at_p32():
                        capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, r.stderr[-3000:]
     assert "OK" in r.stdout, r.stdout
+
+
+_SCRIPT_R3 = r"""
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+os.environ["JAX_ENABLE_X64"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np, tempfile, os as _os
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+from gpu_mapreduce_tpu.parallel.staging import stage_graph
+from gpu_mapreduce_tpu.models.cc import _cc_sharded_fn
+
+mesh = make_mesh()
+rng = np.random.default_rng(5)
+e = rng.integers(0, 200, (4096, 2)).astype(np.uint64)
+
+t0 = time.time()
+mr = MapReduce(mesh)
+mr.map(1, lambda i, kv, p: kv.add_batch(e, np.zeros(len(e), np.uint8)))
+sg = stage_graph(mr, mesh)
+labels, it = _cc_sharded_fn(mesh, sg.n, max(sg.n, 1))(sg.src, sg.dst,
+                                                      sg.valid)
+assert labels.shape == (sg.n,)
+print(f"staged cc @P=32: {time.time()-t0:.1f}s", flush=True)
+
+from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+t0 = time.time()
+with tempfile.TemporaryDirectory() as tmp:
+    paths = []
+    for i in range(32):
+        p = _os.path.join(tmp, f"f{i}.html")
+        open(p, "wb").write(b'<a href="http://d%02d.org/a">x</a>pad' % i * 3)
+        paths.append(p)
+    ii = InvertedIndex(comm=mesh, engine="xla")
+    nhits, nuniq = ii.run(paths)
+    assert (nhits, nuniq) == (96, 32), (nhits, nuniq)
+print(f"SPMD ingestion @P=32: {time.time()-t0:.1f}s", flush=True)
+print("OK")
+"""
+
+
+def test_round3_paths_compile_at_p32():
+    """Round-3 SPMD paths — device staging and the shard_map ingestion —
+    must trace/compile and run at pod scale (P=32)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, "-c", _SCRIPT_R3], cwd=REPO,
+                       env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "OK" in r.stdout, r.stdout
