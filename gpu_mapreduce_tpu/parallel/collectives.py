@@ -37,6 +37,9 @@ def gather_kv(backend, mr, nprocs: int):
     if skv is None:
         return  # host-resident data is already "gathered"
     n = min(nprocs, backend.nprocs)
+    # shard i → i % n: the reference's exact funnel layout ("lo procs
+    # recv from hi procs with same ID % numprocs",
+    # src/mapreduce.cpp:919-928)
     out = exchange(skv, ("fixed_mod", n),
                    transport=mr.settings.all2all, counters=mr.counters)
     _replace_kv_frames(mr.kv, out)

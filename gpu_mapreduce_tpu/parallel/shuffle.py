@@ -186,7 +186,10 @@ def _dest_fn(dest, nprocs: int, mesh) -> Callable:
     re-shuffle every round; re-jitting per round was the dominant cost):
 
     * ("hash", fn_or_None) — fn(keys)%nprocs, default lookup3;
-    * ("fixed_mod", n) — every row of shard i to shard i%n (gather)."""
+    * ("fixed_mod", n) — every row of shard i to shard i%n: the
+      reference gather's EXACT sender→receiver mapping ("lo procs recv
+      from set of hi procs with same (ID % numprocs)",
+      src/mapreduce.cpp:919-928)."""
     kind = dest[0]
     if kind == "hash":
         fn = dest[1]

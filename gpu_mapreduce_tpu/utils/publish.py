@@ -11,6 +11,18 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def read_published(key: str, path: Optional[str] = None):
+    """The current published.<key> record, or {} (same file layout
+    owner as publish — harnesses merge partial runs through this)."""
+    if path is None:
+        path = os.path.join(_ROOT, "BASELINE.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("published", {}).get(key, {})
+    except (FileNotFoundError, ValueError):
+        return {}
+
+
 def publish(key: str, record, path: Optional[str] = None) -> None:
     """Merge ``record`` under published.<key> of the REPO's
     BASELINE.json (cwd-independent by default)."""

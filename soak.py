@@ -189,15 +189,10 @@ def main():
     # PARTIAL run merges over the previous record (a failed workload
     # must not erase its old row) and exits nonzero so the watcher's
     # success gate keeps retrying.
-    from gpu_mapreduce_tpu.utils.publish import _ROOT, publish
+    from gpu_mapreduce_tpu.utils.publish import publish, read_published
     if errors:
-        try:
-            with open(os.path.join(_ROOT, "BASELINE.json")) as f:
-                prev = json.load(f)["published"].get(f"soak_{backend}", {})
-            for k, v in prev.items():
-                published.setdefault(k, v)
-        except (FileNotFoundError, KeyError, ValueError):
-            pass
+        for k, v in read_published(f"soak_{backend}").items():
+            published.setdefault(k, v)
     publish(f"soak_{backend}", published)
     print("BASELINE.json published:", json.dumps(published))
     if errors:

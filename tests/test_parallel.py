@@ -404,3 +404,29 @@ def test_one_sync_per_sharded_op(mesh):
     got = {}
     mr.scan_kv(lambda k, v, p: got.__setitem__(int(k), int(v)))
     assert got == dict(oracle)
+
+
+def test_gather_reference_mod_layout(mesh):
+    """gather(n): producing shard i's rows land on shard i % n — the
+    reference's exact sender→receiver mapping ("lo procs recv from hi
+    procs with same ID % numprocs", src/mapreduce.cpp:919-928)."""
+    mr = MapReduce(mesh)
+    keys = np.arange(64, dtype=np.uint64)
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, keys))
+    mr.aggregate()
+    before = mr.kv.one_frame()
+    k_before = np.asarray(before.key)
+    owner = {}
+    for p in range(before.nprocs):
+        blk = k_before[p * before.cap:p * before.cap + int(before.counts[p])]
+        for k in blk.tolist():
+            owner[k] = p
+    mr.gather(3)            # n ∤ P: the layouts genuinely differ here
+    after = mr.kv.one_frame()
+    assert int(after.counts[:3].sum()) == 64
+    k_after = np.asarray(after.key)
+    for dest in range(3):
+        blk = k_after[dest * after.cap:
+                      dest * after.cap + int(after.counts[dest])]
+        for k in blk.tolist():
+            assert owner[k] % 3 == dest, (k, owner[k], dest)
