@@ -1,0 +1,113 @@
+"""Mosaic compile proof — the cheapest irrefutable TPU artifact.
+
+VERDICT r3 #1b: the moment the axon tunnel answers, FIRST prove the
+Pallas word-mark kernel (`ops/pallas/match.mark_words_pallas`, the §2.3
+mapping of /root/reference/cuda/InvertedIndex.cu:79-135) actually
+compiles via Mosaic with ``interpret=False`` and runs on the chip —
+before spending tunnel time on bench/soak.  Seconds of chip time, and it
+removes the "interpret=False has never executed anywhere" gap.
+
+Writes, into the REPO (so the evidence survives the round):
+  * MOSAIC_PROOF.json  — backend, device kind, compile/run seconds,
+    oracle agreement, timestamp
+  * MOSAIC_PROOF.hlo.txt — head of the compiled module text (the Mosaic
+    custom-call is the smoking gun)
+
+Run standalone or from scripts/tpu_watch.sh.  Exits nonzero unless the
+kernel really compiled and ran on a TPU backend with interpret=False.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+REPO = "/root/repo"
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    rec = {
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "backend": backend,
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "interpret": False,
+    }
+    if backend not in ("tpu", "axon"):
+        rec["error"] = f"not a TPU backend: {backend}"
+        print(json.dumps(rec))
+        return 1
+
+    from gpu_mapreduce_tpu.ops.pallas.match import (
+        mark_words_pallas, mark_words_xla, bytes_view_u32)
+    from gpu_mapreduce_tpu.apps.invertedindex import PATTERN
+
+    # ~8 MB synthetic page with a known sprinkle of hrefs
+    rng = np.random.default_rng(7)
+    buf = rng.integers(97, 123, size=8 << 20, dtype=np.uint8)
+    hits = rng.choice(buf.shape[0] - 64, size=2048, replace=False)
+    pat = np.frombuffer(PATTERN, np.uint8)
+    for h in hits:
+        buf[h:h + pat.shape[0]] = pat
+    words = jnp.asarray(bytes_view_u32(buf))
+
+    fn = jax.jit(lambda w: mark_words_pallas(w, PATTERN, interpret=False))
+    tl = time.time()
+    lowered = fn.lower(words)
+    compiled = lowered.compile()
+    rec["compile_sec"] = round(time.time() - tl, 3)
+
+    tr = time.time()
+    out = compiled(words)
+    out.block_until_ready()
+    rec["first_run_sec"] = round(time.time() - tr, 4)
+    tr = time.time()
+    out = compiled(words)
+    out.block_until_ready()
+    rec["warm_run_sec"] = round(time.time() - tr, 4)
+    rec["bytes"] = int(buf.shape[0])
+    rec["warm_bytes_per_sec"] = round(buf.shape[0] / max(rec["warm_run_sec"], 1e-9))
+
+    # oracle agreement: the compiler-twin on the same device
+    oracle = np.asarray(jax.jit(lambda w: mark_words_xla(w, PATTERN))(words))
+    got = np.asarray(out)
+    rec["oracle_match"] = bool((got == oracle).all())
+    rec["nmatches"] = int((got != 0).sum())
+    rec["nmatches_expected"] = int((oracle != 0).sum())
+
+    hlo = compiled.as_text()
+    rec["hlo_len"] = len(hlo)
+    rec["hlo_has_custom_call"] = "custom-call" in hlo or "custom_call" in hlo
+    with open(f"{REPO}/MOSAIC_PROOF.hlo.txt", "w") as f:
+        f.write(hlo[:20000])
+
+    # Second proof, still cheap: the byte-granularity kernel twin
+    try:
+        from gpu_mapreduce_tpu.ops.pallas.match import mark_pallas
+        b = jnp.asarray(buf[: 1 << 20])
+        fn2 = jax.jit(lambda x: mark_pallas(x, PATTERN, interpret=False))
+        t2 = time.time()
+        m2 = fn2(b)
+        m2.block_until_ready()
+        rec["mark_pallas_byte_kernel_sec"] = round(time.time() - t2, 3)
+        rec["mark_pallas_ok"] = True
+    except Exception as e:  # record but don't fail the headline proof
+        rec["mark_pallas_ok"] = False
+        rec["mark_pallas_error"] = repr(e)[:500]
+
+    rec["total_sec"] = round(time.time() - t0, 2)
+    with open(f"{REPO}/MOSAIC_PROOF.json", "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+    return 0 if rec["oracle_match"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

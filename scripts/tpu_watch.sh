@@ -1,37 +1,51 @@
 #!/bin/bash
 # Watch the flaky axon TPU tunnel; the moment it answers, capture the
-# round's real-TPU records (VERDICT r2 #1b):
-#   * bench.py  -> /tmp/bench_tpu.out   (stdout JSON metric line)
-#   * soak.py   -> BASELINE.json published.soak_<backend> (fused engines)
-# The tunnel hangs rather than errors when down (see utils/platform.py),
-# so every probe and run sits under a hard timeout.  The watcher only
-# stops once BOTH captures really ran on a TPU backend — a mid-run
-# tunnel drop (bench falls back to CPU, or timeout kills it) loops back
-# to probing instead of declaring victory.
+# round's real-TPU records in CHEAPEST-FIRST order (VERDICT r3 #1):
+#   1. scripts/mosaic_proof.py -> MOSAIC_PROOF.json (+ .hlo.txt) —
+#      Pallas mark kernel compiled via Mosaic, interpret=False, seconds
+#   2. bench.py                -> /tmp/bench_tpu.out (headline JSON line)
+#   3. bench.py BENCH_MB=2048 BENCH_SKEW=1 -> published at-volume row
+#   4. soak.py                 -> BASELINE.json published.soak_<backend>
+# Every probe attempt is appended to the IN-REPO log TPU_PROBE_LOG.txt
+# (VERDICT r3 #1a: the round must leave evidence of TPU contact attempts
+# even if the tunnel never answers).  The tunnel hangs rather than
+# errors when down (see utils/platform.py), so every probe and run sits
+# under a hard timeout.  A mid-run tunnel drop loops back to probing.
 cd /root/repo || exit 1
 LOG=/tmp/tpu_watch.log
-BENCH_OK=0
-SOAK_OK=0
+PROBELOG=/root/repo/TPU_PROBE_LOG.txt
+PROOF_OK=0; BENCH_OK=0; SOAK_OK=0
+[ -f MOSAIC_PROOF.json ] && grep -q '"oracle_match": true' MOSAIC_PROOF.json && PROOF_OK=1
 while true; do
   if timeout 240 python -c "import jax; b = jax.default_backend(); assert b in ('tpu', 'axon'), b" 2>>"$LOG"; then
-    echo "$(date -u +%FT%TZ) tunnel UP — capturing bench + soak" >>"$LOG"
+    echo "$(date -u +%FT%TZ) probe OK (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$PROBELOG"
+    echo "$(date -u +%FT%TZ) tunnel UP — capturing (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$LOG"
+    if [ "$PROOF_OK" = 0 ]; then
+      timeout 900 python scripts/mosaic_proof.py >/tmp/mosaic_proof.out 2>/tmp/mosaic_proof.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) mosaic_proof rc=$rc $(tail -c 400 /tmp/mosaic_proof.out)" >>"$PROBELOG"
+      [ $rc -eq 0 ] && PROOF_OK=1
+    fi
     if [ "$BENCH_OK" = 0 ]; then
       BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=2 \
         timeout 5400 python bench.py >/tmp/bench_tpu.out 2>/tmp/bench_tpu.err
       rc=$?
       echo "$(date -u +%FT%TZ) bench rc=$rc $(cat /tmp/bench_tpu.out)" >>"$LOG"
+      echo "$(date -u +%FT%TZ) bench rc=$rc $(tail -c 300 /tmp/bench_tpu.out)" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu.out; then
         BENCH_OK=1
         cp /tmp/bench_tpu.out /tmp/bench_tpu.captured
+        cp /tmp/bench_tpu.out /root/repo/BENCH_TPU_CAPTURE.json
       fi
     fi
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
-      # the at-volume corpus shape (VERDICT r2 #9): multi-batch (2 GiB
-      # > the 1 GiB int32 batch cap) + skewed keys + long-URL tail
+      # the at-volume corpus shape: multi-batch (2 GiB > the 1 GiB int32
+      # batch cap) + skewed keys + long-URL tail
       BENCH_MB=2048 BENCH_SKEW=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
         timeout 5400 python bench.py >/tmp/bench_tpu_scale.out 2>/tmp/bench_tpu_scale.err
       rc=$?
       echo "$(date -u +%FT%TZ) bench-scale rc=$rc $(cat /tmp/bench_tpu_scale.out)" >>"$LOG"
+      echo "$(date -u +%FT%TZ) bench-scale rc=$rc" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_scale.out; then
         if python scripts/record_scale.py /tmp/bench_tpu_scale.out /tmp/bench_tpu_scale.err >>"$LOG" 2>&1; then
           touch /tmp/bench_scale_done
@@ -43,16 +57,20 @@ while true; do
         timeout 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
       rc=$?
       echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$LOG"
+      echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq 'soak_(tpu|axon)' BASELINE.json; then
         SOAK_OK=1
       fi
     fi
-    if [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ] && [ -f /tmp/bench_scale_done ]; then
+    if [ "$PROOF_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ] && [ -f /tmp/bench_scale_done ]; then
       touch /tmp/tpu_captured.flag
+      echo "$(date -u +%FT%TZ) ALL records captured on TPU" >>"$PROBELOG"
       echo "$(date -u +%FT%TZ) all records captured on TPU" >>"$LOG"
       exit 0
     fi
+  else
+    echo "$(date -u +%FT%TZ) probe FAIL (timeout/backend-not-tpu)" >>"$PROBELOG"
   fi
-  echo "$(date -u +%FT%TZ) tunnel down or capture incomplete (bench=$BENCH_OK soak=$SOAK_OK)" >>"$LOG"
+  echo "$(date -u +%FT%TZ) loop (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$LOG"
   sleep 240
 done
