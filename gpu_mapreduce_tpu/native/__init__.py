@@ -72,6 +72,9 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.mr_intern_ranges.argtypes = [u8p, p(i64), p(i64), i64, u32, u32,
                                      p(u64)]
     lib.mr_intern_ranges.restype = None
+    lib.mr_intern_ranges2.argtypes = [u8p, p(i64), p(i64), i64, u32, u32,
+                                      u32, u32, p(u64), p(u64)]
+    lib.mr_intern_ranges2.restype = None
     lib.mr_parse_table.restype = i64
     lib.mr_parse_table.argtypes = [u8p, i64, i64, p(ctypes.c_int32),
                                    p(ctypes.c_void_p), i64]
@@ -134,6 +137,27 @@ def intern_ranges(buf: np.ndarray, starts: np.ndarray, lens: np.ndarray,
                           _arr(lens, ctypes.c_int64), n, seed_hi, seed_lo,
                           _arr(out, ctypes.c_uint64))
     return out
+
+
+def intern_ranges2(buf: np.ndarray, starts: np.ndarray, lens: np.ndarray,
+                   alt_hi: int, alt_lo: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Both u64 id families over (start, len) ranges in one pass over
+    ``buf``: (intern ids, alt-family check ids).  Equivalent to two
+    :func:`intern_ranges` calls but reads each URL byte once."""
+    n = len(starts)
+    starts = np.ascontiguousarray(starts, np.int64)
+    lens = np.ascontiguousarray(lens, np.int64)
+    out0 = np.empty(n, np.uint64)
+    out1 = np.empty(n, np.uint64)
+    if isinstance(buf, np.ndarray):
+        ptr = _arr(np.ascontiguousarray(buf, np.uint8), ctypes.c_uint8)
+    else:
+        ptr = _u8(buf)
+    _lib.mr_intern_ranges2(ptr, _arr(starts, ctypes.c_int64),
+                           _arr(lens, ctypes.c_int64), n, 0, 0xDEADBEEF,
+                           alt_hi, alt_lo, _arr(out0, ctypes.c_uint64),
+                           _arr(out1, ctypes.c_uint64))
+    return out0, out1
 
 
 def intern64_batch(buf: bytes, offsets: np.ndarray) -> np.ndarray:

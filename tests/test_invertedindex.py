@@ -264,3 +264,37 @@ def test_map_stats_multi_batch_and_wide(html_corpus, tmp_path, monkeypatch):
     ii2.run([str(f)])
     assert ii2.stats["wide_fallbacks"] >= 1, ii2.stats
     assert ii2.stats["nlong_max"] > 0
+
+
+def test_fold_id_check_detects_collisions_within_and_across_batches():
+    """u64 intern collision safety on the no-url-dict path: one id
+    carrying two alt-family values must raise — immediately when both
+    pairs sit in one batch, and at (deferred) compaction when they span
+    batches (the r4 doubling-trigger rework of _fold_id_check)."""
+    import numpy as np
+    import pytest
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+
+    idx = InvertedIndex(engine="native")
+    ids = np.array([5, 7, 5], np.uint64)
+    alts = np.array([1, 2, 9], np.uint64)
+    with pytest.raises(ValueError, match="collision"):
+        idx._fold_id_check(ids, alts)
+
+    idx = InvertedIndex(engine="native")
+    idx._fold_id_check(np.array([5, 7], np.uint64),
+                       np.array([1, 2], np.uint64))
+    idx._fold_id_check(np.array([8, 5], np.uint64),
+                       np.array([3, 9], np.uint64))  # 5 -> 9 vs 1: deferred
+    with pytest.raises(ValueError, match="collision"):
+        idx._compact_chk_runs()
+
+    # benign duplicates (same id, same alt) across batches survive
+    idx = InvertedIndex(engine="native")
+    idx._fold_id_check(np.array([5, 7], np.uint64),
+                       np.array([1, 2], np.uint64))
+    idx._fold_id_check(np.array([5, 8], np.uint64),
+                       np.array([1, 3], np.uint64))
+    idx._compact_chk_runs()
+    (ri, ra), = idx._chk_runs
+    assert ri.tolist() == [5, 7, 8] and ra.tolist() == [1, 2, 3]

@@ -160,3 +160,15 @@ def test_find_hrefs_edge_positions():
     assert native.find_hrefs(b'<a href="')[0].size == 0   # no quote
     assert native.find_hrefs(b"")[0].size == 0
     assert native.find_hrefs(b"<" * 64)[0].size == 0
+
+
+def test_intern_ranges2_matches_two_single_family_passes():
+    rng = np.random.default_rng(3)
+    buf = rng.integers(0, 256, 4096, dtype=np.uint8)
+    starts = np.sort(rng.choice(3800, 40, replace=False)).astype(np.int64)
+    lens = rng.integers(0, 200, 40, dtype=np.int64)  # incl. len 0 and >12
+    ah, al = 0x9E3779B9, 0x85EBCA6B
+    ids, alts = native.intern_ranges2(buf, starts, lens, ah, al)
+    assert ids.tolist() == native.intern_ranges(buf, starts, lens).tolist()
+    assert alts.tolist() == \
+        native.intern_ranges(buf, starts, lens, ah, al).tolist()
