@@ -104,12 +104,26 @@ def save(mr, path: str) -> int:
             os.rename(path, old)
         try:
             os.rename(tmp, path)
-        except BaseException:
+        except BaseException as swap_err:
             if not os.path.exists(path) and os.path.exists(old):
-                os.rename(old, path)   # put the previous checkpoint back
+                try:
+                    os.rename(old, path)  # put the previous one back
+                except OSError as restore_err:
+                    # double fault: the new rename AND the restore both
+                    # failed — `old` is now the only surviving copy, so
+                    # it must outlive this call (ADVICE r3: the finally
+                    # below used to delete it)
+                    raise MRError(
+                        f"checkpoint swap failed ({swap_err!r}) and the "
+                        f"previous checkpoint could not be restored "
+                        f"({restore_err!r}); it survives at {old!r}"
+                    ) from swap_err
             raise
     finally:
-        shutil.rmtree(old, ignore_errors=True)
+        # only discard `old` once a checkpoint really sits at `path`
+        # (the new one, or the restored previous one)
+        if os.path.exists(path):
+            shutil.rmtree(old, ignore_errors=True)
         shutil.rmtree(tmp, ignore_errors=True)
     return nframes
 

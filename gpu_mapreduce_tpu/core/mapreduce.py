@@ -691,9 +691,18 @@ class MapReduce:
             budget = self._hbm_budget_bytes()
             if interned and budget is not None and fr.nbytes() > budget:
                 # the interned device sort is GLOBAL (GSPMD gathers the
-                # whole dataset transiently) — past the budget, decode
-                # to host and take the external/host path instead
-                fr = fr.to_host()
+                # whole dataset transiently) — past the budget, demote
+                # shard-by-shard into page frames (spilling past
+                # maxpage) so the bounded external merge applies; a
+                # single to_host() frame never qualified for
+                # _use_external and just relocated the blow-up from HBM
+                # to controller RAM (ADVICE r3)
+                self._demote_mesh_kv()
+                kv = self.kv
+                if not callable(flag_or_cmp) and self._use_external(kv):
+                    return self._sort_kv_external(kv, by,
+                                                  flag_or_cmp < 0, t)
+                fr = kv.one_frame()
             elif not callable(flag_or_cmp):
                 # per-shard device sort; an interned byte/object column
                 # sorts by an id→rank surrogate built once from the

@@ -70,18 +70,43 @@ def _skv_map_jit(mesh, fn, static, nextra):
     return run
 
 
-def skv_map(skv: ShardedKV, fn, static=(), extra=()) -> ShardedKV:
+def _check_decodes(fr, preserve_decodes: bool, what: str):
+    """Interned byte/object ids look like plain numbers inside a kernel
+    body; silently doing arithmetic on them is the bug reduce_sharded
+    already guards against (ADVICE r3: the kernel-map path did not).
+    ``preserve_decodes=True`` is the caller's assertion that the kernel
+    treats ids as opaque and keeps them in the same id space, so the
+    tables stay valid on the output frame."""
+    if preserve_decodes:
+        return fr.key_decode, fr.value_decode
+    if fr.key_decode is not None or fr.value_decode is not None:
+        which = [n for n, t in (("key", fr.key_decode),
+                                ("value", fr.value_decode)) if t is not None]
+        raise ValueError(
+            f"{what}: {'/'.join(which)} entries are interned byte/object "
+            f"ids — a numeric kernel over them is meaningless; decode to "
+            f"host first, or pass preserve_decodes=True if the kernel "
+            f"treats them as opaque ids")
+    return None, None
+
+
+def skv_map(skv: ShardedKV, fn, static=(), extra=(),
+            preserve_decodes: bool = False) -> ShardedKV:
     """Run a per-shard KV kernel body ``fn(key, value, count, *extra,
     *static) → (okey, ovalue, valid)`` and pack the result into a new
     ShardedKV.  ``static`` values are jit constants (shapes, caps);
     ``extra`` values are TRACED replicated operands (seeds, thresholds) —
-    varying them re-uses the compiled kernel."""
+    varying them re-uses the compiled kernel.  Frames carrying decode
+    tables are rejected unless ``preserve_decodes`` (see
+    :func:`_check_decodes`)."""
+    kd, vd = _check_decodes(skv, preserve_decodes, "skv_map")
     counts = jax.device_put(skv.counts.astype(np.int32),
                             row_sharding(skv.mesh))
     k, v, c = _skv_map_jit(skv.mesh, fn, tuple(static), len(extra))(
         skv.key, skv.value, counts, *extra)
     SyncStats.pulls += 1
-    return ShardedKV(skv.mesh, k, v, np.asarray(c).astype(np.int32))
+    return ShardedKV(skv.mesh, k, v, np.asarray(c).astype(np.int32),
+                     key_decode=kd, value_decode=vd)
 
 
 @functools.lru_cache(maxsize=None)
@@ -100,17 +125,20 @@ def _skmv_map_jit(mesh, fn, static, nextra):
     return run
 
 
-def skmv_map(kmv: ShardedKMV, fn, static=(), extra=()) -> ShardedKV:
+def skmv_map(kmv: ShardedKMV, fn, static=(), extra=(),
+             preserve_decodes: bool = False) -> ShardedKV:
     """Run a per-shard KMV kernel body ``fn(ukey, nvalues, voffsets,
     values, gcount, vcount, *extra, *static) → (okey, ovalue, valid)`` (a
-    vectorised appreduce) and pack into a new ShardedKV.  ``extra`` as in
-    :func:`skv_map`."""
+    vectorised appreduce) and pack into a new ShardedKV.  ``extra`` and
+    the decode-table guard as in :func:`skv_map`."""
+    kd, vd = _check_decodes(kmv, preserve_decodes, "skmv_map")
     put = lambda x: jax.device_put(x.astype(np.int32), row_sharding(kmv.mesh))
     k, v, c = _skmv_map_jit(kmv.mesh, fn, tuple(static), len(extra))(
         kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values,
         put(kmv.gcounts), put(kmv.vcounts), *extra)
     SyncStats.pulls += 1
-    return ShardedKV(kmv.mesh, k, v, np.asarray(c).astype(np.int32))
+    return ShardedKV(kmv.mesh, k, v, np.asarray(c).astype(np.int32),
+                     key_decode=kd, value_decode=vd)
 
 
 # ---------------------------------------------------------------------------

@@ -28,8 +28,14 @@ def publish(key: str, record, path: Optional[str] = None) -> None:
     BASELINE.json (cwd-independent by default)."""
     if path is None:
         path = os.path.join(_ROOT, "BASELINE.json")
-    with open(path) as f:
-        base = json.load(f)
+    try:
+        with open(path) as f:
+            base = json.load(f)
+    except (FileNotFoundError, ValueError):
+        # a missing or corrupt baseline must not crash a harness at the
+        # very end of a long capture and lose the run (ADVICE r3);
+        # mirror read_published's tolerance and start a fresh file
+        base = {}
     base.setdefault("published", {})[key] = record
     with open(path, "w") as f:
         json.dump(base, f, indent=2)

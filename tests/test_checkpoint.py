@@ -148,3 +148,42 @@ def test_example_in_checkpoint(tmp_path, monkeypatch):
     a = sorted((tmp_path / "deg.original").read_text().split())
     b = sorted((tmp_path / "deg.restored").read_text().split())
     assert a == b and len(a) > 0
+
+
+def test_save_double_fault_preserves_old_checkpoint(tmp_path, monkeypatch):
+    """ADVICE r3: if the tmp→path rename fails AND the old→path restore
+    also fails, the previous checkpoint must survive on disk (the
+    cleanup used to rmtree the only remaining copy)."""
+    import os
+
+    from gpu_mapreduce_tpu.core import checkpoint
+
+    path = str(tmp_path / "ck")
+    mr = MapReduce()
+    mr.map(1, lambda i, kv, p: kv.add_batch(
+        np.arange(8, dtype=np.uint64), np.ones(8, np.uint64)))
+    mr.save(path)
+
+    mr2 = MapReduce()
+    mr2.map(1, lambda i, kv, p: kv.add_batch(
+        np.arange(4, dtype=np.uint64), np.zeros(4, np.uint64)))
+
+    real_rename = os.rename
+
+    def failing_rename(src, dst):
+        if dst == path:            # both the swap and the restore
+            raise OSError("injected rename failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(checkpoint.os, "rename", failing_rename)
+    with pytest.raises(MRError, match="survives"):
+        mr2.save(path)
+    monkeypatch.undo()
+
+    old = [d for d in os.listdir(tmp_path) if d.startswith("ck.old.")]
+    assert old, "previous checkpoint dir was deleted in the double fault"
+    mr3 = MapReduce()
+    mr3.load(str(tmp_path / old[0]))
+    got = []
+    mr3.scan_kv(lambda k, v, p: got.append(int(k)))
+    assert sorted(got) == list(range(8))

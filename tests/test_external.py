@@ -206,3 +206,35 @@ def test_mesh_demote_with_spilled_host_frames(tmp_path, rng):
     got = {}
     mr.reduce(lambda k, vl, kv, p: got.__setitem__(int(k), len(vl)))
     assert got == dict(oracle)
+
+
+def test_mesh_interned_sort_over_global_budget(tmp_path, rng):
+    """ADVICE r3: an interned mesh KV whose PER-SHARD bytes fit the HBM
+    budget but whose GLOBAL bytes exceed it (the interned device sort
+    gathers globally) must demote shard-by-shard into page frames and
+    sort through the bounded external path — not decode everything into
+    one controller-RAM frame."""
+    import jax
+
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.parallel.sharded import ShardedKV
+
+    ndev = 8
+    assert len(jax.devices()) >= ndev
+    mr = MapReduce(make_mesh(ndev), outofcore=1, memsize=MEMSIZE_MB,
+                   maxpage=1, fpath=str(tmp_path))
+    nrows = 3 * BUDGET // 16           # ids are u64 pairs: 16 B/row
+    words = [b"w%06d" % (i % 40000) for i in range(nrows)]
+    vals = rng.integers(0, 1 << 30, nrows).astype(np.uint64)
+    mr.map(1, lambda i, kv, p: kv.add_batch(words, vals))
+    mr.aggregate()
+    fr = mr.kv.one_frame()
+    assert isinstance(fr, ShardedKV) and fr.key_decode is not None
+    assert fr.nbytes() > BUDGET            # global gather would blow it
+    assert fr.nbytes() // ndev <= BUDGET   # but per-shard fits
+    c = _fresh_counters()
+    mr.sort_keys(5)
+    assert c.msizemax <= 3 * BUDGET, f"peak {c.msizemax} vs {BUDGET}"
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append(bytes(k)))
+    assert got == sorted(words)

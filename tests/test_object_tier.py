@@ -247,3 +247,33 @@ def test_cbridge_sort_cmp_and_scan_kmv(tmp_path):
     cbridge.mr_scan_kmv(h, _ptr(scan), 0)
     assert seen == {b"pear": 1, b"fig": 2, b"apple": 1}
     cbridge.mr_destroy(h)
+
+
+def test_skv_map_rejects_interned_frames_unless_opted_in():
+    """ADVICE r3: a numeric kernel routed through skv_map/skmv_map over
+    interned byte ids silently does arithmetic on hashes — the kernel-map
+    path must guard like reduce_sharded, with an explicit opt-out that
+    propagates the decode tables."""
+    import jax.numpy as jnp
+    import pytest
+
+    from gpu_mapreduce_tpu.parallel.devkernels import skv_map
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    mr = MapReduce(make_mesh(4))
+    mr.map(1, lambda i, kv, p: [kv.add(w, 1) for w in
+                                (b"alpha", b"beta", b"gamma", b"delta")])
+    mr.aggregate()
+    fr = mr.kv.one_frame()
+    assert fr.key_decode is not None
+
+    def ident(k, v, c):
+        n = k.shape[0]
+        return k, v, jnp.arange(n) < c
+
+    with pytest.raises(ValueError, match="interned"):
+        skv_map(fr, ident)
+    out = skv_map(fr, ident, preserve_decodes=True)
+    assert out.key_decode is fr.key_decode
+    got = sorted(bytes(b) for b in out.to_host().key.data)
+    assert got == [b"alpha", b"beta", b"delta", b"gamma"]
