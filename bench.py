@@ -108,15 +108,23 @@ def probe_backend(timeout: float, retries: int = 3):
 
 
 def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4,
-                skew: bool = False):
+                skew: bool = False, dense: bool = False):
     """Deterministic synthetic HTML: filler with a URL every ~1KB.
 
     ``skew`` (BENCH_SKEW=1, VERDICT r2 #9): ~25% of references hit a
     64-URL hot set (RMAT-hub-style shuffle skew) and ~2% are 120–200
     byte long-tail URLs (drives the two-tier window's second gather).
+
+    ``dense`` (BENCH_DENSE=1, VERDICT r3 #4): ~4 refs/KB — past the
+    device tier's 1-href/KB capacity heuristic, so the extract MUST
+    take a cap retry — and ~60% long URLs — past the cap/4 wide-window
+    threshold, so the whole-corpus wide fallback MUST engage; records
+    those two paths executing outside pytest.
     Returns (paths, total refs, unique urls)."""
     per_file = (total_mb << 20) // nfiles
     filler = b"<p>" + b"lorem ipsum dolor sit amet " * 36 + b"</p>\n"  # ~1KB
+    if dense:
+        filler = filler[:220]  # ~4 refs/KB: above the 1/KB cap heuristic
     hot = [b"http://example.org/hot/%02d" % i for i in range(64)]
     paths = []
     uid = 0
@@ -126,7 +134,11 @@ def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4,
         pieces = []
         size = 0
         while size < per_file:
-            if skew and nref % 50 == 49:   # checked first: ~2% long tail
+            if dense and nref % 5 < 3:     # ~60% long: force wide windows
+                u = (b"http://example.org/long/"
+                     + b"p%08d/" % uid + b"x" * (96 + uid % 80))
+                uid += 1
+            elif skew and nref % 50 == 49:  # checked first: ~2% long tail
                 u = (b"http://example.org/long/"
                      + b"p%08d/" % uid + b"x" * (96 + uid % 80))
                 uid += 1
@@ -151,6 +163,7 @@ def make_corpus(tmpdir: str, total_mb: int, nfiles: int = 4,
 def run_bench(engine, backend_err):
     total_mb = int(os.environ.get("BENCH_MB", "256"))
     skew = os.environ.get("BENCH_SKEW", "0") == "1"
+    dense = os.environ.get("BENCH_DENSE", "0") == "1"
     import jax
     jax.config.update("jax_enable_x64", True)  # u64 url ids on device
     enable_compilation_cache()
@@ -162,7 +175,8 @@ def run_bench(engine, backend_err):
         comm = make_mesh(1)  # 1-chip mesh: KV stays device-resident
 
     with tempfile.TemporaryDirectory() as tmpdir:
-        paths, nurls, nuniq = make_corpus(tmpdir, total_mb, skew=skew)
+        paths, nurls, nuniq = make_corpus(tmpdir, total_mb, skew=skew,
+                                          dense=dense)
         nbytes = sum(os.path.getsize(p) for p in paths)
 
         # warmup at FULL shapes so the timed run measures steady state
@@ -197,7 +211,7 @@ def run_bench(engine, backend_err):
     map_bytes_per_sec = nbytes / map_time
     detail = {
         "npairs": npairs, "nunique": nunique, "bytes": nbytes,
-        "corpus": {"mb": total_mb, "skew": skew},
+        "corpus": {"mb": total_mb, "skew": skew, "dense": dense},
         "map_stage_sec": round(map_time, 4),
         "map_stage_bytes_per_sec": round(map_bytes_per_sec, 1),
         "end_to_end_sec": round(dt, 3),
