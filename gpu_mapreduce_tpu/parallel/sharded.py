@@ -259,6 +259,38 @@ class ShardedKMV:
         return KMVFrame(key_col if key_col is not None else DenseColumn(key),
                         nvalues, offsets, val_col)
 
+    def shard_to_host(self, p: int) -> KMVFrame:
+        """Host KMVFrame of ONE shard's groups — device_get of just that
+        shard's blocks (per-shard output files stream shards one at a
+        time; ``to_host`` would materialise the whole dataset on the
+        controller — VERDICT r3 #7)."""
+        ToHostStats.kmv += 1
+        gcap, vcap = self.gcap, self.vcap
+        g = int(self.gcounts[p])
+        nval = int(self.vcounts[p])
+
+        def block(arr, start, n):
+            for sh in arr.addressable_shards:
+                if (sh.index[0].start or 0) == start:
+                    return np.asarray(sh.data)[:n]
+            raise ValueError(f"shard {p} not addressable on this host")
+
+        uk = block(self.ukey, p * gcap, g)
+        nv = block(self.nvalues, p * gcap, g).astype(np.int64)
+        vo = block(self.voffsets, p * gcap, g).astype(np.int64)
+        vals = block(self.values, p * vcap, nval)
+        offsets = np.concatenate([[0], np.cumsum(nv)]).astype(np.int64)
+        total = int(offsets[-1])
+        idx = (np.repeat(vo - offsets[:-1], nv)
+               + np.arange(total, dtype=np.int64))
+        values = vals[idx]
+        key_col = (_decode_col(self.key_decode, uk)
+                   if self.key_decode is not None else DenseColumn(uk))
+        val_col = (_decode_col(self.value_decode, values)
+                   if self.value_decode is not None
+                   else DenseColumn(values))
+        return KMVFrame(key_col, nv, offsets, val_col)
+
     def groups(self):
         yield from self.to_host().groups()
 

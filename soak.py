@@ -43,6 +43,7 @@ def main():
 
     scale = int(os.environ.get("SOAK_SCALE", "18"))
     nnz = int(os.environ.get("SOAK_NNZ", "8"))
+    nmesh = int(os.environ.get("SOAK_MESH", "1"))  # VERDICT r3 #6: P>1
     backend = jax.default_backend()
     published = {}
     errors = {}
@@ -68,7 +69,7 @@ def main():
     print(f"rmat scale={scale} nnz={nnz}: {nedges} edges in {iters} "
           f"rounds, {dt:.2f}s -> {nedges / dt:,.0f} edges/s")
 
-    mesh = make_mesh(1)
+    mesh = make_mesh(nmesh)
 
     def do_degree():
         # run twice at full shape: the first pass pays the XLA compiles
@@ -176,6 +177,7 @@ def main():
     published["backend"] = backend
     published["rmat_scale"] = scale
     published["nedges"] = nedges
+    published["mesh_devices"] = nmesh
     published["notes"] = (
         "round 3: cc_find times INCLUDE device-side staging (mesh "
         "vertex ranking, parallel/staging.py) where round 2 staged on "
@@ -190,10 +192,11 @@ def main():
     # must not erase its old row) and exits nonzero so the watcher's
     # success gate keeps retrying.
     from gpu_mapreduce_tpu.utils.publish import publish, read_published
+    key = f"soak_{backend}" if nmesh == 1 else f"soak_{backend}_p{nmesh}"
     if errors:
-        for k, v in read_published(f"soak_{backend}").items():
+        for k, v in read_published(key).items():
             published.setdefault(k, v)
-    publish(f"soak_{backend}", published)
+    publish(key, published)
     print("BASELINE.json published:", json.dumps(published))
     if errors:
         raise SystemExit(f"{len(errors)} workload(s) failed: "

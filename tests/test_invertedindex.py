@@ -298,3 +298,40 @@ def test_fold_id_check_detects_collisions_within_and_across_batches():
     idx._compact_chk_runs()
     (ri, ra), = idx._chk_runs
     assert ri.tolist() == [5, 7, 8] and ra.tolist() == [1, 2, 3]
+
+
+@pytest.mark.parametrize("engine", ["xla", "native"])
+def test_mesh_outdir_writes_per_shard_parts(html_corpus, tmp_path, engine):
+    """VERDICT r3 #7: an 8-device run writes 8 part-<shard> files from
+    per-shard data (url bytes decoded from the destination shard's own
+    dict on the device tier), and their union matches the serial
+    oracle's single output file."""
+    import collections
+    import os
+
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    oracle = collections.defaultdict(set)
+    for f in html_corpus:
+        for u in oracle_urls(open(f, "rb").read()):
+            oracle[u].add(f)
+
+    ii = InvertedIndex(engine=engine, comm=make_mesh(8))
+    outdir = str(tmp_path / f"out_{engine}")
+    nhits, nurl = ii.run(html_corpus, outdir=outdir)
+    parts = sorted(os.listdir(outdir))
+    assert parts == [f"part-{p:05d}" for p in range(8)]
+    assert nurl == len(oracle)
+    got = {}
+    for part in parts:
+        with open(os.path.join(outdir, part)) as fh:
+            for line in fh:
+                url, names = line.rstrip("\n").split("\t")
+                assert url.encode() not in got   # each key on ONE shard
+                got[url.encode()] = set(names.split(" "))
+    assert got == dict(oracle)
+    if engine == "xla":
+        # the device tier never built a controller-global dict
+        assert ii.shard_urls is not None
+        assert sum(len(d) for d in ii.shard_urls) == len(oracle)
+        assert ii._urls == {}
