@@ -280,6 +280,16 @@ def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
     return phase2
 
 
+class ExchangeStats:
+    """Telemetry of the LAST exchange's flow control (class attrs, like
+    sharded.ToHostStats): the multi-round path is invisible from the
+    outside — results are identical either way — so the driver dryrun
+    and tests assert on these to prove skew actually engaged it
+    (VERDICT r3 #5)."""
+    last_nrounds = 0
+    last_bucket = 0
+
+
 def exchange(skv: ShardedKV, dest, transport: int = 1,
              counters=None) -> ShardedKV:
     """Full ragged exchange: route every valid row to its dest shard.
@@ -311,6 +321,8 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         B = round_cap(-(-Bmax // nrounds))
         nrounds = -(-Bmax // B)
 
+    ExchangeStats.last_nrounds = nrounds
+    ExchangeStats.last_bucket = B
     out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out)(
         skey, svalue, counts_local)
     if counters is not None:
