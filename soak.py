@@ -179,12 +179,13 @@ def main():
     published["nedges"] = nedges
     published["mesh_devices"] = nmesh
     published["notes"] = (
-        "round 3: cc_find times INCLUDE device-side staging (mesh "
-        "vertex ranking, parallel/staging.py) where round 2 staged on "
-        "the controller with np.unique — slower on the 1-device CPU "
-        "fake (single-core XLA sort) but removes the controller funnel "
-        "the mesh cannot outgrow; compare cc rows across rounds with "
-        "that in mind")
+        "cc_find times INCLUDE device-side staging (mesh vertex "
+        "ranking, parallel/staging.py; r3+) — slower on CPU fakes "
+        "(single-core XLA sort) but removes the controller funnel the "
+        "mesh cannot outgrow.  mesh_devices>1 rows on a CPU fake "
+        "cluster time-slice ONE core across P shards while paying real "
+        "collective+padding cost: they record multi-device EXECUTION, "
+        "not speedup (BASELINE.md 'Soak P=1 vs P=8')")
 
     # backend-qualified key — never wipe records other harnesses own
     # and never let a CPU re-run clobber a previous real-TPU soak.  A
