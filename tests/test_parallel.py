@@ -224,6 +224,9 @@ def test_skewed_exchange_multi_round(mesh, all2all, monkeypatch):
     dest = ("hash", lambda k: k.astype(np.uint32))
     out = shuffle.exchange(skv, dest, transport=all2all)
     assert seen["nrounds"] > 1, "test no longer exercises the multi-round path"
+    # the public telemetry (r4: the driver dryrun asserts on this too)
+    assert shuffle.ExchangeStats.last_nrounds == seen["nrounds"]
+    assert shuffle.ExchangeStats.last_bucket >= 1
     assert multiset(out.to_host().pairs()) == multiset(zip(keys, vals))
     P, cap = out.nprocs, out.cap
     k = np.asarray(out.key).reshape(P, cap)
