@@ -268,9 +268,10 @@ def test_map_stats_multi_batch_and_wide(html_corpus, tmp_path, monkeypatch):
 
 def test_fold_id_check_detects_collisions_within_and_across_batches():
     """u64 intern collision safety on the no-url-dict path: one id
-    carrying two alt-family values must raise — immediately when both
-    pairs sit in one batch, and at (deferred) compaction when they span
-    batches (the r4 doubling-trigger rework of _fold_id_check)."""
+    carrying two alt-family values must raise at compaction — whether
+    the pairs sit in one batch or span batches (the r4 append-only
+    hot loop + doubling-trigger compaction rework of _fold_id_check;
+    run() always compacts at map close)."""
     import numpy as np
     import pytest
     from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
@@ -278,8 +279,9 @@ def test_fold_id_check_detects_collisions_within_and_across_batches():
     idx = InvertedIndex(engine="native")
     ids = np.array([5, 7, 5], np.uint64)
     alts = np.array([1, 2, 9], np.uint64)
+    idx._fold_id_check(ids, alts)   # append only; checked at compaction
     with pytest.raises(ValueError, match="collision"):
-        idx._fold_id_check(ids, alts)
+        idx._compact_chk_runs()
 
     idx = InvertedIndex(engine="native")
     idx._fold_id_check(np.array([5, 7], np.uint64),
