@@ -439,6 +439,7 @@ class InvertedIndex:
         self.timer = StageTimer(groups={"native_scan": "map_kernels",
                                         "host_add": "map_kernels"})
         self._intern_lock = threading.Lock()
+        self._compact_lock = threading.Lock()
         self._keep_bytes = True
         # sorted runs of unique (id, alt-id) pairs when the url dict is
         # skipped — compacted on a doubling trigger so host memory stays
@@ -520,30 +521,42 @@ class InvertedIndex:
         with self._intern_lock:
             self._chk_runs.append((ids, alts))
             self._chk_raw += len(ids)
-            if self._chk_raw > 2 * max(self._chk_base, self._CHK_MIN_COMPACT):
-                self._compact_chk_runs()
+            trigger = self._chk_raw > 2 * max(self._chk_base,
+                                              self._CHK_MIN_COMPACT)
+        if trigger:
+            self._compact_chk_runs()
 
     def _compact_chk_runs(self):
         """Merge all recorded (possibly unsorted, duplicate-bearing)
         batches into one sorted deduped run, raising if any id carries
         two distinct alt values.  Sorting by id alone suffices: within
         an equal-id run any two distinct alts produce some unequal
-        adjacent pair whatever the alt order.  Caller holds
-        ``_intern_lock`` (or is single-threaded at map close)."""
-        if not self._chk_runs:
-            return
-        mi = np.concatenate([r[0] for r in self._chk_runs])
-        ma = np.concatenate([r[1] for r in self._chk_runs])
-        o = np.argsort(mi)                   # introsort: 5x stable on u64
-        mi, ma = mi[o], ma[o]
-        same = mi[1:] == mi[:-1]
-        if (same & (ma[1:] != ma[:-1])).any():
-            raise ValueError("64-bit URL intern collision(s) detected")
-        keep = np.ones(len(mi), bool)
-        keep[1:] = ~same                     # exact-duplicate pairs ok
-        mi, ma = mi[keep], ma[keep]
-        self._chk_runs = [(mi, ma)]
-        self._chk_raw = self._chk_base = len(mi)
+        adjacent pair whatever the alt order.  The run list is swapped
+        out under ``_intern_lock`` but the O(N log N) sort/check runs
+        OUTSIDE it, so mapstyle-2 mapper threads keep appending during
+        a compaction (r4 review: the sort used to hold the lock and
+        serialise the map stage); ``_compact_lock`` keeps compactions
+        themselves serial."""
+        with self._compact_lock:
+            with self._intern_lock:
+                runs, self._chk_runs = self._chk_runs, []
+            if not runs:
+                return
+            taken = sum(len(r[0]) for r in runs)
+            mi = np.concatenate([r[0] for r in runs])
+            ma = np.concatenate([r[1] for r in runs])
+            o = np.argsort(mi)               # introsort: 5x stable on u64
+            mi, ma = mi[o], ma[o]
+            same = mi[1:] == mi[:-1]
+            if (same & (ma[1:] != ma[:-1])).any():
+                raise ValueError("64-bit URL intern collision(s) detected")
+            keep = np.ones(len(mi), bool)
+            keep[1:] = ~same                 # exact-duplicate pairs ok
+            mi, ma = mi[keep], ma[keep]
+            with self._intern_lock:
+                self._chk_runs.insert(0, (mi, ma))
+                self._chk_raw += len(mi) - taken
+                self._chk_base = len(mi)
 
     @property
     def urls(self) -> Dict[int, bytes]:

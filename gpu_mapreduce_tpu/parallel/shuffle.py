@@ -339,7 +339,11 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
     # speculative phase 2: enqueue with last time's caps BEFORE the
     # count-matrix pull, so the pull overlaps device work (async
     # dispatch) instead of gating it
-    spec_key = (mesh, transport, skv.key.shape, skv.key.dtype.str,
+    # dest is part of the key: a gather's fixed-dest exchange and an
+    # aggregate's hash exchange over the same shapes have wildly
+    # different bucket profiles — sharing one slot would cross-
+    # contaminate caps and waste speculative dispatches (r4 review)
+    spec_key = (mesh, transport, dest, skv.key.shape, skv.key.dtype.str,
                 skv.value.shape, skv.value.dtype.str)
     spec = _SPEC_CACHE.get(spec_key)
     out_spec = None

@@ -25,17 +25,26 @@ def read_published(key: str, path: Optional[str] = None):
 
 def publish(key: str, record, path: Optional[str] = None) -> None:
     """Merge ``record`` under published.<key> of the REPO's
-    BASELINE.json (cwd-independent by default)."""
+    BASELINE.json (cwd-independent by default).
+
+    A missing or corrupt baseline must not crash a harness at the very
+    end of a long capture and lose the run (ADVICE r3) — but starting
+    fresh over a CORRUPT file would silently destroy every previously
+    published record (r4 review), so the unparsable file is moved aside
+    to ``<path>.corrupt`` for repair first.  The write itself is
+    tmp+rename so a crash mid-dump can no longer produce such a file."""
     if path is None:
         path = os.path.join(_ROOT, "BASELINE.json")
     try:
         with open(path) as f:
             base = json.load(f)
-    except (FileNotFoundError, ValueError):
-        # a missing or corrupt baseline must not crash a harness at the
-        # very end of a long capture and lose the run (ADVICE r3);
-        # mirror read_published's tolerance and start a fresh file
+    except FileNotFoundError:
+        base = {}
+    except ValueError:
+        os.replace(path, path + ".corrupt")   # preserve for repair
         base = {}
     base.setdefault("published", {})[key] = record
-    with open(path, "w") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(base, f, indent=2)
+    os.replace(tmp, path)
