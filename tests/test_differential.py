@@ -1,0 +1,147 @@
+"""Differential fuzzing: the SAME random op sequence on a serial
+MapReduce and a mesh MapReduce must produce the SAME KV multiset after
+every step (SURVEY.md §4: one program text, serial or parallel — the
+reference's mpistubs contract, asserted here property-style rather than
+by eyeballing printed counts).
+
+Sequences draw from the core op algebra with state-aware choices
+(convert needs a KV, reduce needs a KMV, ...).  Shapes are held to a
+small fixed set so the mesh side's per-shape jit caches are reused
+across sequences — the fuzz explores DATA and op order, not shapes."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import jax
+
+from gpu_mapreduce_tpu import MapReduce
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+N_ROWS = 320           # one fixed add-batch shape: jit reuse across seqs
+KEYSPACES = (7, 61, 100000)     # heavy dup / moderate / mostly unique
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8
+    return make_mesh(8)
+
+
+def kv_multiset(mr):
+    pairs = []
+    mr.scan_kv(lambda k, v, p: pairs.append((int(k), int(v))))
+    return collections.Counter(pairs)
+
+
+def kv_keysums(mr):
+    """Layout-independent view of a counts KV: compress/reduce are
+    LOCAL ops (reference src/mapreduce.cpp — no communication), so a
+    key gathered onto several procs legitimately yields one count pair
+    PER PROC; the per-key SUM is the invariant both sides share."""
+    sums = collections.defaultdict(int)
+    mr.scan_kv(lambda k, v, p: sums.__setitem__(
+        int(k), sums[int(k)] + int(v)))
+    return dict(sums)
+
+
+def kmv_groups(mr):
+    """Per-key MERGED sorted values: convert (local grouping) makes one
+    group per (key, proc); merging across procs is the invariant."""
+    groups = collections.defaultdict(list)
+    mr.scan_kmv(lambda k, vals, p: groups[int(k)].extend(
+        int(v) for v in vals))
+    return {k: sorted(v) for k, v in groups.items()}
+
+
+def kmv_keysums(mr):
+    sums = collections.defaultdict(int)
+    mr.scan_kmv(lambda k, vals, p: sums.__setitem__(
+        int(k), sums[int(k)] + sum(int(v) for v in vals)))
+    return dict(sums)
+
+
+def gen_batch(rng):
+    ks = rng.integers(0, KEYSPACES[int(rng.integers(len(KEYSPACES)))],
+                      N_ROWS).astype(np.uint64)
+    vs = rng.integers(0, 1 << 30, N_ROWS).astype(np.uint64)
+    return ks, vs
+
+
+def step(op, mr, batch):
+    """Apply one op; returns the state kind afterwards ('kv'/'kmv')."""
+    if op == "add":
+        ks, vs = batch
+        mr.map(1, lambda i, kv, p: kv.add_batch(ks, vs), addflag=1)
+        return "kv"
+    if op == "map_fresh":
+        ks, vs = batch
+        mr.map(1, lambda i, kv, p: kv.add_batch(ks, vs))
+        return "kv"
+    if op == "aggregate":
+        mr.aggregate()
+        return "kv"
+    if op == "convert":
+        mr.convert()
+        return "kmv"
+    if op == "collate":
+        mr.collate()
+        return "kmv"
+    if op == "compress":
+        # SUM reducer: sums stay invariant through repeated LOCAL
+        # reductions (sum of partial sums == global sum), where counts
+        # count layout-dependent pair splits
+        mr.compress(lambda k, vals, kv, p: kv.add(k, sum(vals)))
+        return "kv"
+    if op == "reduce_sum":
+        mr.reduce(lambda k, vals, kv, p: kv.add(k, sum(vals)))
+        return "kv"
+    if op == "sort_keys":
+        mr.sort_keys(1)
+        return "kv"
+    if op == "gather":
+        mr.gather(2)
+        return "kv"
+    raise AssertionError(op)
+
+
+# ops legal per state; both sides always take the SAME choice
+KV_OPS = ("add", "aggregate", "convert", "collate", "compress",
+          "sort_keys", "gather", "map_fresh")
+KMV_OPS = ("reduce_sum",)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_serial_and_mesh_agree_on_random_op_sequences(mesh, seed):
+    rng = np.random.default_rng(1000 + seed)
+    ser = MapReduce()
+    par = MapReduce(mesh)
+    state = None
+    # `exact` degrades to per-key-sum comparison once a LOCAL reduction
+    # (compress/reduce without collate) has produced layout-dependent
+    # count pairs — per-key sums stay invariant through every later op;
+    # a fresh map (state reset) restores exactness
+    exact = True
+    for nstep in range(9):
+        if state is None:
+            op = "map_fresh"
+        elif state == "kmv":
+            op = KMV_OPS[int(rng.integers(len(KMV_OPS)))]
+        else:
+            op = KV_OPS[int(rng.integers(len(KV_OPS)))]
+        batch = gen_batch(rng) if op in ("add", "map_fresh") else None
+        s1 = step(op, ser, batch)
+        s2 = step(op, par, batch)
+        assert s1 == s2
+        state = s1
+        if op == "map_fresh":
+            exact = True
+        elif op in ("compress", "reduce_sum"):
+            exact = False
+        if state == "kmv":
+            cmp = kmv_groups if exact else kmv_keysums
+        else:
+            cmp = kv_multiset if exact else kv_keysums
+        assert cmp(ser) == cmp(par), \
+            f"seed {seed} diverged after step {nstep} ({op})"
