@@ -110,3 +110,36 @@ def test_spmd_ingestion_on_multislice_mesh(tmp_path):
     n2 = ii2.run(paths)
     assert n1 == n2
     assert ii1.urls == ii2.urls
+
+
+def test_per_shard_output_on_multislice_mesh(tmp_path):
+    """r4: per-shard part files + destination-sharded url dicts work on
+    a (slice, chip) mesh too — 8 part files, union == serial oracle."""
+    import collections
+    import os
+
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+
+    paths = []
+    oracle = collections.defaultdict(set)
+    for i in range(6):
+        p = tmp_path / f"g{i}.html"
+        body = []
+        for j in range(30):
+            u = "http://m%d.org/q%d" % (j % 5, j)
+            body.append('<a href="%s">x</a> words ' % u)
+            oracle[u.encode()].add(str(p))
+        p.write_bytes("".join(body).encode())
+        paths.append(str(p))
+    ii = InvertedIndex(engine="xla", comm=make_mesh2(2, 4))
+    outdir = str(tmp_path / "out")
+    nh, nu = ii.run(paths, outdir=outdir)
+    parts = sorted(os.listdir(outdir))
+    assert parts == [f"part-{p:05d}" for p in range(8)]
+    got = {}
+    for part in parts:
+        for line in open(os.path.join(outdir, part)):
+            url, names = line.rstrip("\n").split("\t")
+            assert url.encode() not in got
+            got[url.encode()] = set(names.split(" "))
+    assert got == dict(oracle)
