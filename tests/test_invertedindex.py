@@ -298,7 +298,7 @@ def test_fold_id_check_detects_collisions_within_and_across_batches():
     idx._fold_id_check(np.array([5, 8], np.uint64),
                        np.array([1, 3], np.uint64))
     idx._compact_chk_runs()
-    (ri, ra), = idx._chk_runs
+    ri, ra = idx._chk_sorted
     assert ri.tolist() == [5, 7, 8] and ra.tolist() == [1, 2, 3]
 
 
