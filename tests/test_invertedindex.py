@@ -337,3 +337,48 @@ def test_mesh_outdir_writes_per_shard_parts(html_corpus, tmp_path, engine):
         assert ii.shard_urls is not None
         assert sum(len(d) for d in ii.shard_urls) == len(oracle)
         assert ii._urls == {}
+
+
+def test_fold_id_check_thread_hammer():
+    """4 threads interleave batches (shared hot ids + disjoint tails)
+    while doubling-trigger compactions race the appends; the final
+    compacted run must be exactly the global unique pair set."""
+    import threading
+
+    idx = InvertedIndex(engine="native")
+    idx._CHK_MIN_COMPACT = 256          # force many mid-stream compactions
+    rng = np.random.default_rng(3)
+    hot = np.arange(100, dtype=np.uint64)
+    batches = []
+    for t in range(4):
+        for b in range(30):
+            tail = (np.arange(200, dtype=np.uint64)
+                    + 1000 * (1 + t * 30 + b))
+            ids = np.concatenate([hot, tail])
+            rng.shuffle(ids)
+            batches.append((t, ids))
+    expect = set()
+    for _, ids in batches:
+        expect.update(ids.tolist())
+
+    def work(t):
+        for bt, ids in batches:
+            if bt == t:
+                idx._fold_id_check(ids, ids + np.uint64(7))  # alt = id+7
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    idx._compact_chk_runs()
+    ri, ra = idx._chk_sorted
+    assert idx._chk_tails == []
+    assert set(ri.tolist()) == expect
+    assert (ra == ri + np.uint64(7)).all()
+    assert (np.diff(ri.astype(np.int64)) > 0).all()   # sorted, deduped
+
+    # and a collision smuggled in by one thread still surfaces
+    idx._fold_id_check(np.array([5], np.uint64), np.array([99], np.uint64))
+    with pytest.raises(ValueError, match="collision"):
+        idx._compact_chk_runs()
