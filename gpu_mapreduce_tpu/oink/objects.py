@@ -165,19 +165,57 @@ class ObjectManager:
         """Handle -o descriptor #index: write ``printer(key, value, fp)``
         lines to the path if given; register mr under the name if given.
         Missing descriptor ⇒ no-op (commands always call output; scripts
-        decide, reference oink/object.cpp:237-370)."""
+        decide, reference oink/object.cpp:237-370).
+
+        A mesh-resident dataset on P>1 shards writes PER-SHARD files —
+        ``path.<p>``, or the first ``%`` in the path replaced by the
+        shard id (the reference's expandpath postpend/substitute rules,
+        oink/object.cpp:900-941) — each from its own shard block, so
+        output never funnels the dataset through the controller.  Host
+        datasets (and P==1) keep the exact single path: our serial tier
+        intentionally omits the reference's ``.0`` suffix so script
+        goldens address one file."""
         if index > len(self.outputs):
             return
         d = self.outputs[index - 1]
         if d.path is not None:
-            with open(d.path, "w") as fp:
-                if printer is None:
-                    mr_dump(mr, fp)
-                else:
-                    for k, v in _iter_pairs(mr):
-                        printer(k, v, fp)
+            fr = _mesh_frame(mr)
+            if fr is not None and fr.nprocs > 1:
+                for p in range(fr.nprocs):
+                    if "%" in d.path:
+                        path = d.path.replace("%", str(p), 1)
+                    else:
+                        path = f"{d.path}.{p}"
+                    host = fr.shard_to_host(p)
+                    with open(path, "w") as fp:
+                        rows = (host.pairs() if hasattr(host, "pairs")
+                                else host.groups())
+                        if printer is None:
+                            for k, v in rows:
+                                fp.write(f"{k} {v}\n")
+                        else:
+                            for k, v in rows:
+                                printer(k, v, fp)
+            else:
+                with open(d.path, "w") as fp:
+                    if printer is None:
+                        mr_dump(mr, fp)
+                    else:
+                        for k, v in _iter_pairs(mr):
+                            printer(k, v, fp)
         if d.mr_name is not None:
             self.name_mr(d.mr_name, mr)
+
+
+def _mesh_frame(mr: MapReduce):
+    """The mr's single mesh-resident frame, or None (host/serial data,
+    multi-frame datasets, or no data)."""
+    from ..parallel.sharded import ShardedKMV, ShardedKV
+    ds = mr.kv if mr.kv is not None else mr.kmv
+    if ds is None or ds.nframes != 1:
+        return None
+    fr = next(iter(ds.frames()))
+    return fr if isinstance(fr, (ShardedKV, ShardedKMV)) else None
 
 
 def _iter_pairs(mr: MapReduce):

@@ -194,7 +194,12 @@ def test_degree_on_mesh_backend(edge_file, tmp_path):
     cmd = run_command("degree", ["0"], obj=obj, inputs=[path],
                       outputs=[str(out)], screen=False)
     oracle = collections.Counter(np.concatenate([e[:, 0], e[:, 1]]).tolist())
-    got = {int(a): int(b) for a, b in np.loadtxt(out, dtype=np.int64)}
+    # r4: per-shard output files on the P=4 mesh; union == oracle
+    shard_files = sorted(tmp_path.glob("deg_mesh.out.*"))
+    assert len(shard_files) == 4
+    rows = np.concatenate([np.loadtxt(f, dtype=np.int64).reshape(-1, 2)
+                           for f in shard_files if f.stat().st_size])
+    got = {int(a): int(b) for a, b in rows}
     assert got == dict(oracle)
     assert cmd.nvert == len(oracle)
 
