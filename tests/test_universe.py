@@ -168,8 +168,13 @@ def test_partition_runs_mapreduce_per_world(tmp_path, monkeypatch):
     run_universe(str(script), ["2x4"], comm=make_mesh(8), uscreen=False,
                  screenname="none")
     for w in (0, 1):
-        out = (tmp_path / f"deg.{w}").read_text()
-        assert len(out.splitlines()) > 0
+        # r4: degree's mesh-resident output writes per-shard deg.<w>.<p>
+        # files on each world's 4-device sub-mesh
+        shard_files = sorted(tmp_path.glob(f"deg.{w}.*"))
+        assert len(shard_files) == 4, shard_files
+        lines = [ln for f in shard_files for ln in
+                 f.read_text().splitlines()]
+        assert len(lines) > 0
 
 
 def test_cli_partition_requires_in(tmp_path, monkeypatch):
