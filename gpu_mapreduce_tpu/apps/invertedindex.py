@@ -522,8 +522,11 @@ class InvertedIndex:
         with self._intern_lock:
             self._chk_tails.append((ids, alts))
             self._chk_raw += len(ids)
-            trigger = self._chk_raw > 2 * max(self._chk_base,
-                                              self._CHK_MIN_COMPACT)
+            # _chk_raw counts TAILS only (the standing run left it when
+            # compaction went merge-based), so fire when tails reach
+            # the run size: resident ≈ 2x unique, the ADVICE r2 bound
+            trigger = self._chk_raw > max(self._chk_base,
+                                          self._CHK_MIN_COMPACT)
         if trigger:
             self._compact_chk_runs()
 

@@ -153,22 +153,20 @@ def main():
     def do_tri():
         # triangle counting is O(sum of low-degree^2) — the scale-20
         # RMAT full set is too hot-hub-heavy for one core, so soak the
-        # fused engine on a 2^(scale-3) edge subset like cc does
+        # fused engine on a smaller 2^(scale-3) edge subset (cc, with
+        # its linear per-iter cost, takes 2^(scale-1))
         import tempfile
-
-        from gpu_mapreduce_tpu.oink import ObjectManager as OM
-        from gpu_mapreduce_tpu.oink import run_command as run_cmd
         with tempfile.TemporaryDirectory() as tmp:
             path = os.path.join(tmp, "edges.txt")
             sub = edges[: min(len(edges), 1 << max(4, scale - 3))]
             sub = sub[sub[:, 0] != sub[:, 1]]
             np.savetxt(path, sub, fmt="%d")
-            run_cmd("tri_find", [], obj=OM(comm=mesh), inputs=[path],
-                    screen=False)                 # warm the compile
-            obj = OM(comm=mesh)
+            run_command("tri_find", [], obj=ObjectManager(comm=mesh),
+                        inputs=[path], screen=False)  # warm the compile
+            obj = ObjectManager(comm=mesh)
             t0 = time.perf_counter()
-            cmd = run_cmd("tri_find", [], obj=obj, inputs=[path],
-                          screen=False)
+            cmd = run_command("tri_find", [], obj=obj, inputs=[path],
+                              screen=False)
             dt = time.perf_counter() - t0
             published["tri_edges_per_sec"] = round(len(sub) / dt, 1)
             print(f"tri_find: {cmd.ntri} triangles over {len(sub)} edges, "
