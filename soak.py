@@ -216,6 +216,10 @@ def main():
     # must not erase its old row) and exits nonzero so the watcher's
     # success gate keeps retrying.
     from gpu_mapreduce_tpu.utils.publish import publish, read_published
+    if os.environ.get("SOAK_DRY") == "1":
+        # smoke runs must never clobber a published full-scale row
+        print("SOAK_DRY=1: not publishing", json.dumps(published))
+        return
     key = f"soak_{backend}" if nmesh == 1 else f"soak_{backend}_p{nmesh}"
     if errors:
         for k, v in read_published(key).items():
