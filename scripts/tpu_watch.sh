@@ -52,6 +52,19 @@ while true; do
         fi
       fi
     fi
+    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_xla_done ]; then
+      # engine comparison: the same corpus through the XLA-twin engine
+      # quantifies what the Mosaic kernel buys over plain XLA on chip
+      BENCH_ENGINE=xla BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
+        timeout 3600 python bench.py >/tmp/bench_tpu_xla.out 2>/tmp/bench_tpu_xla.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) bench-xla rc=$rc $(tail -c 300 /tmp/bench_tpu_xla.out)" >>"$PROBELOG"
+      if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_xla.out; then
+        if python scripts/record_scale.py /tmp/bench_tpu_xla.out /tmp/bench_tpu_xla.err bench_tpu_xla >>"$LOG" 2>&1; then
+          touch /tmp/bench_xla_done
+        fi
+      fi
+    fi
     if [ -f /tmp/bench_scale_done ] && [ ! -f /tmp/bench_stress_done ]; then
       # the dense/long-heavy stress shape: cap retry + wide fallback
       # paths executing on the chip (VERDICT r3 #4)
