@@ -110,3 +110,80 @@ def test_round3_paths_compile_at_p32():
                        timeout=420)
     assert r.returncode == 0, r.stderr[-3000:]
     assert "OK" in r.stdout, r.stdout
+
+
+_SCRIPT_R4 = r"""
+import os, time, tempfile, collections
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=32"
+os.environ["JAX_ENABLE_X64"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from gpu_mapreduce_tpu.core.frame import KVFrame
+from gpu_mapreduce_tpu.core.column import DenseColumn
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+from gpu_mapreduce_tpu.parallel.sharded import shard_frame
+from gpu_mapreduce_tpu.parallel import shuffle
+
+mesh = make_mesh()
+P = shuffle.mesh_axis_size(mesh)
+assert P == 32
+
+# (a) speculative exchange at P=32: repeat same-shape exchange must hit
+# the cap cache (no second fresh phase-2 sizing) and stay correct
+rng = np.random.default_rng(9)
+keys = rng.integers(0, 2047, size=8192).astype(np.uint64)
+vals = np.arange(len(keys), dtype=np.uint64)
+oracle = collections.Counter(zip(keys.tolist(), vals.tolist()))
+shuffle._SPEC_CACHE.clear()
+for rep in range(2):
+    skv = shard_frame(KVFrame(DenseColumn(keys), DenseColumn(vals)), mesh)
+    t0 = time.time()
+    out = shuffle.exchange(skv, ("hash", None))
+    got = collections.Counter((int(k), int(v))
+                              for k, v in out.to_host().pairs())
+    assert got == oracle, f"rep {rep}: mismatch"
+    print(f"spec rep {rep}: {time.time()-t0:.1f}s", flush=True)
+assert len(shuffle._SPEC_CACHE) == 1
+
+# (b) per-shard output files at P=32 through the mesh InvertedIndex
+from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+with tempfile.TemporaryDirectory() as tmp:
+    paths = []
+    exp = collections.defaultdict(set)
+    for i in range(P):
+        p = os.path.join(tmp, f"f{i:02d}.html")
+        with open(p, "wb") as f:
+            u = b"http://pod%02d.org/x" % (i % 11)
+            f.write((b'<a href="' + u + b'">x</a>pad ') * 3)
+            exp[u].add(p)
+        paths.append(p)
+    ii = InvertedIndex(engine="xla", comm=mesh)
+    outdir = os.path.join(tmp, "out")
+    nh, nu = ii.run(paths, outdir=outdir)
+    parts = sorted(os.listdir(outdir))
+    assert parts == [f"part-{q:05d}" for q in range(P)], parts
+    got = {}
+    for part in parts:
+        for line in open(os.path.join(outdir, part)):
+            url, names = line.rstrip("\n").split("\t")
+            got[url.encode()] = set(names.split(" "))
+    assert got == dict(exp)
+    assert nh == 3 * P and nu == 11
+print("OK")
+"""
+
+
+def test_round4_paths_compile_at_p32():
+    """r4 paths at pod scale: speculative exchange capacity reuse and
+    the per-shard output writer trace/compile and run at P=32."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, "-c", _SCRIPT_R4], cwd=REPO,
+                       env=env, capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "OK" in r.stdout, r.stdout
