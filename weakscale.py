@@ -35,6 +35,51 @@ def make_files(tmpdir: str, nfiles: int, mb_each: float):
     return paths
 
 
+def main_invertedindex(mb_per_proc: float):
+    """WEAKSCALE_APP=ii: the cuda_scale analog with the FLAGSHIP app —
+    fixed corpus volume per proc while the mesh grows, through the
+    mesh-SPMD ingestion (each shard ingests its own file slice,
+    cuda_scale/InvertedIndex.cu:276 holds ~20x128 MB per proc fixed).
+    Records per-P stage times + the map-stage machinery stats."""
+    from gpu_mapreduce_tpu.utils.platform import pin_platform
+    pin_platform()
+    import jax
+    from bench import make_corpus
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    jax.config.update("jax_enable_x64", True)
+    ndev = len(jax.devices())
+    sizes = [p for p in (1, 2, 4, 8, 16) if p <= ndev]
+    rows = []
+    with tempfile.TemporaryDirectory() as tmpdir:
+        # one file per proc so the SPMD balance gives each shard a
+        # whole file; P uses the first P files (fixed volume/proc)
+        paths, _, _ = make_corpus(tmpdir, int(mb_per_proc * max(sizes)),
+                                  nfiles=max(sizes))
+        for P in sizes:
+            ii = InvertedIndex(engine="xla", comm=make_mesh(P))
+            ii.run(paths[:P])                 # pay the per-mesh compiles
+            ii = InvertedIndex(engine="xla", comm=make_mesh(P))
+            t0 = time.time()
+            npairs, nuniq = ii.run(paths[:P])
+            dt = time.time() - t0
+            stages = {k: round(v, 3) for k, v in
+                      sorted(ii.timer.times.items())}
+            rows.append({"nprocs": P, "npairs": int(npairs),
+                         "nunique": int(nuniq), "total": round(dt, 3),
+                         **stages, "map_stats": ii.stats})
+            print(json.dumps(rows[-1]))
+    record = {"weak_scaling": rows, "mb_per_proc": mb_per_proc,
+              "app": "invertedindex", "backend": jax.default_backend()}
+    print(json.dumps(record))
+    try:
+        from gpu_mapreduce_tpu.utils.publish import publish
+        publish(f"weakscale_ii_{record['backend']}", record)
+    except FileNotFoundError:
+        pass
+
+
 def main():
     from gpu_mapreduce_tpu.utils.platform import pin_platform
     pin_platform()
@@ -90,4 +135,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import os as _os
+    if _os.environ.get("WEAKSCALE_APP") == "ii":
+        main_invertedindex(float(sys.argv[1]) if len(sys.argv) > 1
+                           else 32.0)
+    else:
+        main()
