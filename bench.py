@@ -39,6 +39,18 @@ BASELINE_BYTES_PER_SEC = (64 << 20) / 0.044  # reference 64MB/44ms map stage
 METRIC = "invertedindex_kv_pairs_per_sec_per_chip"
 
 
+def tb_tail(tb_text: str, n: int) -> str:
+    """Last n informative lines of a formatted traceback.  jax appends a
+    traceback-filtering epilogue ('JAX has removed its internal frames
+    ...'), so a naive tail records only the banner and loses the
+    exception — exactly what happened to the round-4 pallas note."""
+    lines = [ln for ln in tb_text.strip().splitlines()
+             if "internal frames" not in ln
+             and "JAX_TRACEBACK_FILTERING" not in ln
+             and not ln.startswith("-----")]
+    return " | ".join(lines[-n:])
+
+
 def emit(value, vs_baseline, error=None, **extra):
     line = {"metric": METRIC, "value": value, "unit": "pairs/sec",
             "vs_baseline": vs_baseline}
@@ -102,8 +114,8 @@ def probe_backend(timeout: float, retries: int = 3):
             continue
         if r.returncode == 0 and r.stdout.strip():
             return r.stdout.strip().splitlines()[-1], None
-        tail = (r.stderr or "").strip().splitlines()[-3:]
-        err = "backend init failed: " + " | ".join(tail)[-400:]
+        err = "backend init failed: " + \
+            tb_tail(r.stderr or "", 3)[-400:]
     return None, err
 
 
@@ -267,7 +279,6 @@ def main():
         force_engine = os.environ.get("BENCH_ENGINE")
         if force_engine:
             engines = [force_engine]
-        last = None
         for i, engine in enumerate(engines):
             try:
                 run_bench(engine, backend_err)
@@ -276,19 +287,18 @@ def main():
                 # Exception, not BaseException: a KeyboardInterrupt or
                 # SystemExit must abort the cascade, not start the next
                 # engine (ADVICE r2)
-                last = traceback.format_exc().strip().splitlines()
                 note = f"engine {engine} failed: " + \
-                    " | ".join(last[-2:])[-300:]
+                    tb_tail(traceback.format_exc(), 3)[-400:]
                 backend_err = (backend_err + " | " + note) if backend_err \
                     else note
                 print(json.dumps({"fallback": note}), file=sys.stderr)
+                traceback.print_exc(file=sys.stderr)
         raise RuntimeError(backend_err or "all engines failed")
     except (KeyboardInterrupt, SystemExit):
         raise   # an interrupt must not be recorded as a 0.0 "result"
     except BaseException:
-        tb = traceback.format_exc().strip().splitlines()
         err = ((backend_err + " | ") if backend_err else "") + \
-            " | ".join(tb[-3:])[-500:]
+            tb_tail(traceback.format_exc(), 3)[-500:]
         emit(0.0, 0.0, error=err)
         sys.exit(0)
 

@@ -1,0 +1,75 @@
+"""Reproduce the bench pallas-engine failure on TPU with full tracebacks.
+
+The round-4 TPU capture showed mosaic_proof's small-corpus pallas runs all
+green, but bench.py's pallas engine at BENCH_MB=256 raised (note lost the
+exception under jax's traceback-filtering epilogue; bench.py now filters
+it).  This script walks the same InvertedIndex pallas path at growing
+corpus sizes and records the first failing size with the REAL exception,
+into PALLAS_DEBUG.json.  Partial results survive crashes AND SIGTERM from
+the watcher's `timeout`: the JSON is rewritten after every completed size.
+
+Run on the chip:  JAX_TRACEBACK_FILTERING=off python scripts/pallas_debug.py
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+import traceback
+
+REPO = "/root/repo"
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    rec = {"backend": jax.default_backend(),
+           "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "runs": []}
+
+    import bench
+    bench.enable_compilation_cache()   # a retry must not re-pay 4 compiles
+    from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    def flush():
+        # rewritten after EVERY size: `timeout` kills with SIGTERM, which
+        # does not unwind to a finally — partial ladders must survive
+        with open(f"{REPO}/PALLAS_DEBUG.json", "w") as f:
+            json.dump(rec, f, indent=1)
+
+    ok = True
+    for mb in (8, 32, 128, 256):
+        entry = {"mb": mb}
+        try:
+            with tempfile.TemporaryDirectory() as tmpdir:
+                paths, nurls, nuniq = bench.make_corpus(tmpdir, mb)
+                t0 = time.time()
+                idx = InvertedIndex(engine="pallas", comm=make_mesh(1))
+                npairs, nunique = idx.run(paths)
+                entry["sec"] = round(time.time() - t0, 2)
+                entry["ok"] = bool(npairs == nurls and nunique == nuniq)
+                entry["npairs"] = int(npairs)
+        except Exception:
+            tb = traceback.format_exc()
+            entry["ok"] = False
+            entry["traceback_tail"] = tb.strip().splitlines()[-25:]
+            rec["runs"].append(entry)
+            flush()
+            print(tb, file=sys.stderr)
+            ok = False
+            break
+        ok = ok and entry["ok"]
+        rec["runs"].append(entry)
+        flush()
+        print(json.dumps(entry), flush=True)
+    print(json.dumps({"done": True, "all_ok": ok, "runs": len(rec["runs"])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
