@@ -278,6 +278,35 @@ def _bucket_words(nwords: int) -> int:
     return -(-n // g) * g
 
 
+# Per-message cap for corpus H2D (words; 8 MW = 32 MB).  The round-4 TPU
+# window transferred the 8 MB proof corpus fine but the bench died at its
+# single 256 MB shard transfer (raise on the pallas attempt, silent hang on
+# the xla retry) — consistent with the axon tunnel failing on large single
+# messages.  Each shard's block therefore travels as bounded device_put
+# chunks concatenated ON the target device; MR_H2D_CHUNK_WORDS overrides.
+H2D_CHUNK_WORDS = 1 << 23
+
+
+def _h2d_sharded(words_host, W: int, P: int, sharding):
+    """Build the row-sharded global corpus [P*W] from per-shard host
+    buffers, each transferred to its own device in ≤H2D_CHUNK_WORDS
+    messages (no [P*W] host concatenation, no unbounded single transfer)."""
+    chunk_w = int(os.environ.get("MR_H2D_CHUNK_WORDS", H2D_CHUNK_WORDS))
+    dmap = sharding.addressable_devices_indices_map((P * W,))
+    shards = []
+    for dev, idx in dmap.items():
+        p = (idx[0].start or 0) // W
+        host = words_host[p]
+        if W > chunk_w:
+            parts = [jax.device_put(host[o:o + chunk_w], dev)
+                     for o in range(0, W, chunk_w)]
+            shards.append(jnp.concatenate(parts))
+        else:
+            shards.append(jax.device_put(host, dev))
+    return jax.make_array_from_single_device_arrays(
+        (P * W,), sharding, shards)
+
+
 def _shard_blocks(arr, P: int):
     """Per-shard host copies of a row-sharded global array [P*cap] —
     device_get of each addressable shard, no global gather."""
@@ -694,12 +723,7 @@ class InvertedIndex:
                 fstarts_host[p, :len(fstarts)] = fstarts
                 base_host[p] = base
             with self.timer.stage("h2d"):
-                # each shard's block goes straight to ITS device — the
-                # callback hands jax the per-shard host buffer for the
-                # slice it asks for; no [P*W] host concatenation
-                words_g = jax.make_array_from_callback(
-                    (P * W,), sharding,
-                    lambda idx: words_host[(idx[0].start or 0) // W])
+                words_g = _h2d_sharded(words_host, W, P, sharding)
                 fstarts_g = jax.device_put(fstarts_host.reshape(-1),
                                            sharding)
                 base_g = jax.device_put(base_host, sharding)

@@ -21,6 +21,7 @@ bit-identical output by construction.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -230,12 +231,25 @@ def bytes_view_u32(data: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(data).view(np.dtype("<u4"))
 
 
-def mark_words_pallas(words, pattern: bytes, interpret: bool = False):
-    """Word-packed Pallas mark over a u32/i32 word buffer [m] → int8 word
-    mask [m]: 0 = no match, a+1 = pattern starts at byte 4*i+a."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+# Fixed page size for the paged mark (words; 4 MW = 16 MB of corpus per
+# Pallas dispatch).  The round-4 TPU window proved the kernel green at the
+# 8 MB proof shape (grid ~33) but the 256 MB single-dispatch bench shape
+# (grid ~1024) raised with the traceback lost to the tunnel drop; paging
+# keeps every on-chip dispatch at the proven shape class — one Mosaic
+# compile regardless of corpus size — and bounds what any per-dispatch
+# scale limit can see.  Exact by construction: mask word i depends only on
+# words i..i+nw-1 (nw = ceil((len(pattern)+3+3)/4)), so pages overlap by
+# nw-1 words.  Override with MR_MARK_PAGE_WORDS (tests use tiny pages to
+# cross page seams; the debug ladder can bisect with it).
+MARK_PAGE_WORDS = 1 << 22
 
+
+def mark_words_pallas(words, pattern: bytes, interpret: bool = False,
+                      page_words: int | None = None):
+    """Word-packed Pallas mark over a u32/i32 word buffer [m] → int8 word
+    mask [m]: 0 = no match, a+1 = pattern starts at byte 4*i+a.  Buffers
+    larger than ``page_words`` are marked page-by-page (same compiled
+    kernel per page; see MARK_PAGE_WORDS)."""
     if _min_period(pattern) < 4:
         raise ValueError(
             f"pattern period {_min_period(pattern)} < 4: two alignments of "
@@ -244,6 +258,30 @@ def mark_words_pallas(words, pattern: bytes, interpret: bool = False):
     m = words.shape[0]
     if words.dtype != jnp.int32:
         words = jax.lax.bitcast_convert_type(words, jnp.int32)
+    if page_words is None:
+        page_words = int(os.environ.get("MR_MARK_PAGE_WORDS",
+                                        MARK_PAGE_WORDS))
+    if m > page_words:
+        ov = masks.shape[1] - 1
+        npages = -(-m // page_words)
+        pad = npages * page_words + ov - m
+        padded = jnp.concatenate([words, jnp.zeros(pad, jnp.int32)])
+        outs = [
+            _mark_words_call(padded[p * page_words:
+                                    p * page_words + page_words + ov],
+                             masks, vals, interpret)[:page_words]
+            for p in range(npages)]
+        return jnp.concatenate(outs)[:m]
+    return _mark_words_call(words, masks, vals, interpret)
+
+
+def _mark_words_call(words, masks, vals, interpret: bool):
+    """One Pallas dispatch over an i32 word buffer [m] (the pre-r4 whole-
+    buffer path; pages funnel through here at a fixed shape)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = words.shape[0]
     blk = WORD_BLOCK_ROWS * LANES
     # one concatenate: round up to a block multiple AND append the zero
     # sentinel block the next-block-head index map reads past the end

@@ -59,6 +59,30 @@ def test_mark_words_pallas_vs_oracle(rng, offsets):
     assert int(cnt) == len(oracle)
 
 
+def test_mark_words_pallas_paged_matches_single(rng):
+    """The r4 paged mark (fixed 16 MB dispatches on chip) must be
+    bit-identical to the single-dispatch kernel and the XLA twin —
+    including matches whose pattern bytes STRADDLE a page seam."""
+    page = 2048  # words; tiny so the test crosses several seams
+    n = 4 * (3 * page + 100)  # 3 full pages + a ragged tail
+    seam = 4 * page
+    # plants spaced >= len(PATTERN) so none clobbers another; seam-2 and
+    # 2*seam-5 straddle the first and second page seams respectively
+    offsets = (0, seam - 16, seam - 2, seam + 8, 2 * seam - 5, n - 64)
+    buf = _planted_buffer(rng, n, offsets)
+    words = jnp.asarray(bytes_view_u32(buf))
+    paged = np.asarray(mark_words_pallas(words, PATTERN, interpret=True,
+                                         page_words=page))
+    single = np.asarray(mark_words_pallas(words, PATTERN, interpret=True,
+                                          page_words=len(words)))
+    oracle = np.asarray(mark_words_xla(words, PATTERN))
+    np.testing.assert_array_equal(paged, single)
+    np.testing.assert_array_equal(paged, oracle)
+    starts, cnt = compact_word_matches(jnp.asarray(paged), n, 64)
+    st = np.asarray(starts)
+    np.testing.assert_array_equal(np.sort(st[st < n]), _byte_oracle(buf))
+
+
 def test_word_mask_agrees_with_byte_mask(rng):
     buf = _planted_buffer(rng, 4096, (7, 130, 1001))
     words = jnp.asarray(bytes_view_u32(buf))
