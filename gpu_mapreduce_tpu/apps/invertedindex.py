@@ -160,23 +160,33 @@ def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
     if wide:
         nlong = jnp.int32(0)
     else:
-        # long tail: quote beyond the 64-byte window → re-gather 256 B
+        # long tail: quote beyond the 64-byte window → re-gather 256 B.
+        # Under lax.cond since r4: at PUMA density nlong is 0 and the
+        # skipped branch saves cap/4 rows x 65-word random gathers — the
+        # skip is exact because the nlong==0 regather was a no-op anyway
+        # (every lidx == cap scatters with mode="drop").
         is_long = (lengths < 0) & (starts < nbytes)
         nlong = jnp.sum(is_long.astype(jnp.int32))
-        pos = jnp.cumsum(is_long.astype(jnp.int32)) - 1
-        tgt = jnp.where(is_long & (pos < cap_long), pos, cap_long)
-        lidx = jnp.full(cap_long, cap, jnp.int32).at[tgt].set(
-            jnp.arange(cap, dtype=jnp.int32), mode="drop")
-        lst = jnp.where(lidx < cap,
-                        jnp.take(ustarts, jnp.minimum(lidx, cap - 1)),
-                        jnp.int32(nbytes))
-        lwin = unaligned_words(words, lst, nw)
-        lln = first_byte_pos(lwin, QUOTE)
-        lln = jnp.where(lln >= _W_SHORT * 4, lln, jnp.int32(-1))
-        lids, lalt = _hash2(lwin, lln)
-        ids = ids.at[lidx].set(lids, mode="drop")
-        alts = alts.at[lidx].set(lalt, mode="drop")
-        lengths = lengths.at[lidx].set(lln, mode="drop")
+
+        def _regather(ids, alts, lengths):
+            pos = jnp.cumsum(is_long.astype(jnp.int32)) - 1
+            tgt = jnp.where(is_long & (pos < cap_long), pos, cap_long)
+            lidx = jnp.full(cap_long, cap, jnp.int32).at[tgt].set(
+                jnp.arange(cap, dtype=jnp.int32), mode="drop")
+            lst = jnp.where(lidx < cap,
+                            jnp.take(ustarts, jnp.minimum(lidx, cap - 1)),
+                            jnp.int32(nbytes))
+            lwin = unaligned_words(words, lst, nw)
+            lln = first_byte_pos(lwin, QUOTE)
+            lln = jnp.where(lln >= _W_SHORT * 4, lln, jnp.int32(-1))
+            lids, lalt = _hash2(lwin, lln)
+            return (ids.at[lidx].set(lids, mode="drop"),
+                    alts.at[lidx].set(lalt, mode="drop"),
+                    lengths.at[lidx].set(lln, mode="drop"))
+
+        ids, alts, lengths = lax.cond(
+            nlong > 0, _regather, lambda i, a, l: (i, a, l),
+            ids, alts, lengths)
         # nlong returns RAW (callers compare against cap_long): the
         # stats must show the second gather ran even below the
         # wide-retry threshold

@@ -1,0 +1,147 @@
+"""On-chip breakdown of the fused map stage — where does 256 MB/0.98 s go?
+
+The 03:15Z window's first green TPU bench recorded map_device 0.98 s for
+256 MB (274 MB/s) vs the reference GPU map stage's 1.45 GB/s
+(cuda/InvertedIndex.cu:337-384).  This script times each sub-computation
+of apps/invertedindex._extract_core separately at the bench shape so the
+next tuning pass aims at the real hot spot instead of a guess:
+
+  mark        word-packed Pallas mark (paged)          [ops/pallas/match.py]
+  compact     cumsum + scatter-drop hit compaction
+  gather      two-tier unaligned URL window gather
+  hash        masked u64 interning over the windows
+  pack        searchsorted doc-ids + validity argsort + collision check
+  full        the fused _extract_fn dispatch (everything above, one jit)
+
+Writes TPU_MAP_PROFILE.json (partial results survive a mid-run tunnel
+drop: rewritten after every timed section).  Run only on the chip; ~2 min.
+"""
+import functools
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = "/root/repo"
+sys.path.insert(0, REPO)
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon plugin's register() overrides the env var and grabs the
+    # chip; a CPU smoke run must pin BEFORE jax initialises (see
+    # .claude/skills/verify/SKILL.md gotchas)
+    from gpu_mapreduce_tpu.utils.platform import pin_platform
+    pin_platform("cpu")
+
+
+def timed(fn, *args, reps=3):
+    import jax
+    out = fn(*args)            # compile + first run
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_enable_x64", True)
+    import bench
+    bench.enable_compilation_cache()
+    from gpu_mapreduce_tpu.apps import invertedindex as ii
+    from gpu_mapreduce_tpu.ops.hash import hash_bytes64_masked
+    from gpu_mapreduce_tpu.ops.pallas import match as mt
+
+    rec = {"backend": jax.default_backend(),
+           "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "mb": int(os.environ.get("PROFILE_MB", "256")), "sections": {}}
+
+    def flush():
+        with open(f"{REPO}/TPU_MAP_PROFILE.json", "w") as f:
+            json.dump(rec, f, indent=1)
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        paths, nurls, _ = bench.make_corpus(tmpdir, rec["mb"])
+        corpus, fstarts = ii._build_corpus(paths)
+    words = jnp.asarray(mt.bytes_view_u32(corpus))
+    nbytes = int(corpus.shape[0])
+    del corpus
+    m = int(words.shape[0])
+    cap = max(8, 1 << (max(1, nbytes // 1024) - 1).bit_length())  # engine's
+    rec["m_words"] = m
+    rec["cap"] = cap
+    interp = jax.default_backend() == "cpu"   # CPU smoke runs interpret
+    rec["interpret"] = interp
+
+    # mark (the paged Pallas kernel exactly as the engine runs it)
+    mark = jax.jit(functools.partial(mt.mark_words_pallas, pattern=ii.PATTERN,
+                                     interpret=interp))
+    rec["sections"]["mark"] = round(timed(mark, words), 4)
+    flush()
+
+    # compact: cumsum + scatter-drop over the word mask
+    wmask = mark(words)
+    comp = jax.jit(functools.partial(mt.compact_word_matches,
+                                     nbytes=nbytes, max_hits=cap))
+    rec["sections"]["compact"] = round(timed(comp, wmask), 4)
+    flush()
+
+    starts, _ = comp(wmask)
+    ustarts = starts + np.int32(len(ii.PATTERN))
+
+    # gather: the 64-byte first-tier window gather over all cap rows
+    gat = jax.jit(functools.partial(mt.unaligned_words, nwords=ii._W_SHORT))
+    rec["sections"]["gather"] = round(timed(gat, words, ustarts), 4)
+    flush()
+
+    # hash: masked u64 interning — BOTH id families, as the engine's
+    # _hash2 computes (primary + independent alt for collision checks)
+    win = gat(words, ustarts)
+    lens = jax.jit(functools.partial(mt.first_byte_pos, byte=ii.QUOTE))(win)
+
+    def _hash(w, l):
+        l0 = jnp.maximum(l, 0)
+        wm = mt.mask_words_to_length(w, l0)
+        return (hash_bytes64_masked(wm, l0),
+                hash_bytes64_masked(wm, l0, 0x9E3779B9, 0x85EBCA6B))
+
+    rec["sections"]["hash"] = round(timed(jax.jit(_hash), win, lens), 4)
+    flush()
+
+    # pack: searchsorted + validity argsort + the 5 packing takes + the
+    # fused collision check (_count_collisions lexsort), as _extract_core
+    ids, alts = jax.jit(_hash)(win, lens)
+    fst = jnp.asarray(fstarts)
+
+    def _pack(ids, alts, lengths, starts):
+        docs = (jnp.searchsorted(fst, starts, side="right")
+                .astype(jnp.int32) - 1)
+        valid = (starts < nbytes) & (lengths >= 0)
+        npairs = jnp.sum(valid.astype(jnp.int32))
+        order = jnp.argsort(~valid, stable=True)
+        pack = lambda x: jnp.take(x, order, axis=0)
+        pids, palts = pack(ids), pack(alts)
+        ncoll = ii._count_collisions(
+            pids, palts, jnp.arange(ids.shape[0]) < npairs)
+        return (pids, palts, pack(docs), pack(starts), pack(lengths), ncoll)
+
+    rec["sections"]["pack"] = round(
+        timed(jax.jit(_pack), ids, alts, lens, starts), 4)
+    flush()
+
+    # full fused dispatch — the engine's actual map_device program
+    fn = ii._extract_fn(cap, True, interp)
+    rec["sections"]["full"] = round(timed(fn, words, fst), 4)
+    rec["full_bytes_per_sec"] = round(nbytes / rec["sections"]["full"], 1)
+    flush()
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
