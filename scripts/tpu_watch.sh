@@ -1,54 +1,116 @@
 #!/bin/bash
 # Watch the flaky axon TPU tunnel; the moment it answers, capture the
 # round's real-TPU records in CHEAPEST-FIRST order (VERDICT r3 #1):
-#   1. scripts/mosaic_proof.py -> MOSAIC_PROOF.json (+ .hlo.txt) —
-#      Pallas mark kernel compiled via Mosaic, interpret=False, seconds
-#   2. bench.py                -> /tmp/bench_tpu.out (headline JSON line)
-#   3. bench.py BENCH_MB=2048 BENCH_SKEW=1 -> published at-volume row
-#   4. soak.py                 -> BASELINE.json published.soak_<backend>
-# Every probe attempt is appended to the IN-REPO log TPU_PROBE_LOG.txt
-# (VERDICT r3 #1a: the round must leave evidence of TPU contact attempts
-# even if the tunnel never answers).  The tunnel hangs rather than
-# errors when down (see utils/platform.py), so every probe and run sits
-# under a hard timeout.  A mid-run tunnel drop loops back to probing.
+#   1. scripts/mosaic_proof.py   -> MOSAIC_PROOF.json (+ .hlo.txt)
+#   2. bench.py                  -> BENCH_TPU_CAPTURE.json (headline)
+#   3. scripts/tpu_profile_map.py-> TPU_MAP_PROFILE.json (map breakdown)
+#   4. bench.py BENCH_MB=2048 BENCH_SKEW=1 -> published at-volume row
+#   5. BENCH_ENGINE=xla          -> engine-comparison row
+#   6. BENCH_DENSE               -> stress row (cap retry / wide fallback)
+#   7. soak.py                   -> BASELINE.json published.soak_<backend>
+#   8. scripts/pallas_debug.py   -> PALLAS_DEBUG.json size ladder
+# Every probe attempt is appended to the IN-REPO log TPU_PROBE_LOG.txt.
+#
+# r4 second-window lesson: the tunnel can drop BETWEEN steps, and the
+# next step then hangs at backend init with ZERO cpu until its multi-hour
+# `timeout` expires (the 03:22Z 2GiB bench sat 37+ min at 0:27 cpu with
+# no corpus even generated).  run_step therefore (a) re-probes in a
+# throwaway subprocess before each step, (b) kills any step whose
+# cumulative cpu time advances <2s over a 420s stretch — a genuine
+# capture is either computing or transferring (the transfer loop burns
+# cpu serialising chunks); only a dead client sits at zero.
 cd /root/repo || exit 1
 LOG=/tmp/tpu_watch.log
 PROBELOG=/root/repo/TPU_PROBE_LOG.txt
 PROOF_OK=0; BENCH_OK=0; SOAK_OK=0
 [ -f MOSAIC_PROOF.json ] && grep -q '"oracle_match": true' MOSAIC_PROOF.json && PROOF_OK=1
+
+cpu_ticks() {  # utime+stime ticks of pid $1 and all its descendants
+  local total=0 pid
+  for pid in $1 $(pgrep -P "$1" 2>/dev/null); do
+    if [ -r "/proc/$pid/stat" ]; then
+      set -- $(cat "/proc/$pid/stat" 2>/dev/null)
+      total=$((total + ${14:-0} + ${15:-0}))
+    fi
+  done
+  echo $total
+}
+
+probe_ok() {
+  timeout 240 python -c \
+    "import jax; b = jax.default_backend(); assert b in ('tpu','axon'), b" \
+    2>>"$LOG"
+}
+
+run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  if ! probe_ok; then
+    echo "$(date -u +%FT%TZ) skip $name (tunnel gone)" >>"$PROBELOG"
+    return 9
+  fi
+  "$@" & local pid=$!
+  local t0=$(date +%s) last_ticks=0 last_adv=$(date +%s)
+  while kill -0 $pid 2>/dev/null; do
+    sleep 30
+    local now=$(date +%s) ticks=$(cpu_ticks $pid)
+    if [ $((ticks - last_ticks)) -ge 2 ]; then
+      last_ticks=$ticks; last_adv=$now
+    elif [ $((now - last_adv)) -ge 420 ]; then
+      echo "$(date -u +%FT%TZ) $name HUNG (cpu stalled ${ticks}t) — killed" \
+        >>"$PROBELOG"
+      kill -TERM $pid 2>/dev/null; sleep 5; kill -KILL $pid 2>/dev/null
+      pkill -KILL -P $pid 2>/dev/null
+      wait $pid 2>/dev/null
+      return 8
+    fi
+    if [ $((now - t0)) -ge "$tmo" ]; then
+      echo "$(date -u +%FT%TZ) $name TIMEOUT ${tmo}s — killed" >>"$PROBELOG"
+      kill -TERM $pid 2>/dev/null; sleep 5; kill -KILL $pid 2>/dev/null
+      pkill -KILL -P $pid 2>/dev/null
+      wait $pid 2>/dev/null
+      return 7
+    fi
+  done
+  wait $pid
+}
+
 while true; do
-  if timeout 240 python -c "import jax; b = jax.default_backend(); assert b in ('tpu', 'axon'), b" 2>>"$LOG"; then
+  if probe_ok; then
     echo "$(date -u +%FT%TZ) probe OK (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$PROBELOG"
-    echo "$(date -u +%FT%TZ) tunnel UP — capturing (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$LOG"
     if [ "$PROOF_OK" = 0 ]; then
-      timeout 900 python scripts/mosaic_proof.py >/tmp/mosaic_proof.out 2>/tmp/mosaic_proof.err
+      run_step mosaic_proof 900 python scripts/mosaic_proof.py \
+        >/tmp/mosaic_proof.out 2>/tmp/mosaic_proof.err
       rc=$?
       echo "$(date -u +%FT%TZ) mosaic_proof rc=$rc $(tail -c 400 /tmp/mosaic_proof.out)" >>"$PROBELOG"
       [ $rc -eq 0 ] && PROOF_OK=1
     fi
     if [ "$BENCH_OK" = 0 ]; then
-      # 3600 not 5400: a mid-run tunnel drop hangs the process silently
-      # (01:04Z window: 40 min at zero CPU) — bound what a hang can cost
-      # while leaving room for the pallas->xla->native engine cascade
       BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=2 \
-        timeout 3600 python bench.py >/tmp/bench_tpu.out 2>/tmp/bench_tpu.err
+        run_step bench 3600 python bench.py \
+        >/tmp/bench_tpu.out 2>/tmp/bench_tpu.err
       rc=$?
-      echo "$(date -u +%FT%TZ) bench rc=$rc $(cat /tmp/bench_tpu.out)" >>"$LOG"
       echo "$(date -u +%FT%TZ) bench rc=$rc $(tail -c 300 /tmp/bench_tpu.out)" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu.out; then
         BENCH_OK=1
-        cp /tmp/bench_tpu.out /tmp/bench_tpu.captured
         cp /tmp/bench_tpu.out /root/repo/BENCH_TPU_CAPTURE.json
+        grep detail /tmp/bench_tpu.err | tail -1 \
+          > /root/repo/BENCH_TPU_CAPTURE_DETAIL.json 2>/dev/null
       fi
     fi
-    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
-      # the at-volume corpus shape: multi-batch (2 GiB > the 1 GiB int32
-      # batch cap) + skewed keys + long-URL tail
-      BENCH_MB=2048 BENCH_SKEW=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
-        timeout 5400 python bench.py >/tmp/bench_tpu_scale.out 2>/tmp/bench_tpu_scale.err
+    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/map_profile_done ]; then
+      run_step map_profile 1800 python scripts/tpu_profile_map.py \
+        >/tmp/map_profile.out 2>/tmp/map_profile.err
       rc=$?
-      echo "$(date -u +%FT%TZ) bench-scale rc=$rc $(cat /tmp/bench_tpu_scale.out)" >>"$LOG"
-      echo "$(date -u +%FT%TZ) bench-scale rc=$rc" >>"$PROBELOG"
+      echo "$(date -u +%FT%TZ) map_profile rc=$rc $(tail -c 300 /tmp/map_profile.out)" >>"$PROBELOG"
+      [ $rc -eq 0 ] && grep -q '"full"' TPU_MAP_PROFILE.json 2>/dev/null \
+        && touch /tmp/map_profile_done
+    fi
+    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
+      BENCH_MB=2048 BENCH_SKEW=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
+        run_step bench_scale 5400 python bench.py \
+        >/tmp/bench_tpu_scale.out 2>/tmp/bench_tpu_scale.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) bench-scale rc=$rc $(tail -c 200 /tmp/bench_tpu_scale.out)" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_scale.out; then
         if python scripts/record_scale.py /tmp/bench_tpu_scale.out /tmp/bench_tpu_scale.err >>"$LOG" 2>&1; then
           touch /tmp/bench_scale_done
@@ -56,10 +118,9 @@ while true; do
       fi
     fi
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_xla_done ]; then
-      # engine comparison: the same corpus through the XLA-twin engine
-      # quantifies what the Mosaic kernel buys over plain XLA on chip
       BENCH_ENGINE=xla BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
-        timeout 3600 python bench.py >/tmp/bench_tpu_xla.out 2>/tmp/bench_tpu_xla.err
+        run_step bench_xla 3600 python bench.py \
+        >/tmp/bench_tpu_xla.out 2>/tmp/bench_tpu_xla.err
       rc=$?
       echo "$(date -u +%FT%TZ) bench-xla rc=$rc $(tail -c 300 /tmp/bench_tpu_xla.out)" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_xla.out; then
@@ -68,11 +129,10 @@ while true; do
         fi
       fi
     fi
-    if [ -f /tmp/bench_scale_done ] && [ ! -f /tmp/bench_stress_done ]; then
-      # the dense/long-heavy stress shape: cap retry + wide fallback
-      # paths executing on the chip (VERDICT r3 #4)
+    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_stress_done ]; then
       BENCH_MB=64 BENCH_DENSE=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
-        timeout 3600 python bench.py >/tmp/bench_tpu_stress.out 2>/tmp/bench_tpu_stress.err
+        run_step bench_stress 3600 python bench.py \
+        >/tmp/bench_tpu_stress.out 2>/tmp/bench_tpu_stress.err
       rc=$?
       echo "$(date -u +%FT%TZ) bench-stress rc=$rc" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_stress.out; then
@@ -81,11 +141,10 @@ while true; do
         fi
       fi
     fi
-    if [ "$SOAK_OK" = 0 ]; then
+    if [ "$SOAK_OK" = 0 ] && [ "$BENCH_OK" = 1 ]; then
       SOAK_SCALE="${SOAK_SCALE:-20}" \
-        timeout 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
+        run_step soak 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
       rc=$?
-      echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$LOG"
       echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq 'soak_(tpu|axon)' BASELINE.json; then
         SOAK_OK=1
@@ -94,21 +153,17 @@ while true; do
     DBG_TRIES=$(cat /tmp/pallas_debug_tries 2>/dev/null || echo 0)
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/pallas_debug_done ] \
         && [ "$DBG_TRIES" -lt 3 ]; then
-      # 01:03Z window: pallas green at proof scale, raised at bench scale.
-      # Walk the size ladder and record the real exception per size into
-      # PALLAS_DEBUG.json.  Runs AFTER every published capture (publish
-      # first — diagnosis data must not cost a recorded row), capped at 3
-      # attempts so a persistent failure can't eat every future window.
       echo $((DBG_TRIES + 1)) >/tmp/pallas_debug_tries
-      timeout 2400 python scripts/pallas_debug.py >/tmp/pallas_debug.out 2>/tmp/pallas_debug.err
+      run_step pallas_debug 2400 python scripts/pallas_debug.py \
+        >/tmp/pallas_debug.out 2>/tmp/pallas_debug.err
       rc=$?
       echo "$(date -u +%FT%TZ) pallas_debug rc=$rc $(tail -c 300 /tmp/pallas_debug.out)" >>"$PROBELOG"
       [ $rc -eq 0 ] && [ -f PALLAS_DEBUG.json ] && touch /tmp/pallas_debug_done
     fi
-    if [ "$PROOF_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ] && [ -f /tmp/bench_scale_done ]; then
+    if [ "$PROOF_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ] \
+        && [ -f /tmp/bench_scale_done ]; then
       touch /tmp/tpu_captured.flag
       echo "$(date -u +%FT%TZ) ALL records captured on TPU" >>"$PROBELOG"
-      echo "$(date -u +%FT%TZ) all records captured on TPU" >>"$LOG"
       exit 0
     fi
   else
