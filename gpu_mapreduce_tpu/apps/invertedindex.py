@@ -46,10 +46,10 @@ from jax import lax
 from ..core.mapreduce import MapReduce
 from .. import native
 from ..ops.hash import hash_bytes64_masked
-from ..ops.pallas.match import (bytes_view_u32, compact_word_matches,
-                                first_byte_pos, mark_words_pallas,
-                                mark_words_xla, mask_words_to_length,
-                                unaligned_words)
+from ..ops.pallas.match import (MARK_PAGE_WORDS, bytes_view_u32,
+                                compact_word_matches, first_byte_pos,
+                                mark_words_pallas, mark_words_xla,
+                                mask_words_to_length, unaligned_words)
 from ..utils.io import findfiles
 from ..utils.platform import is_tpu_backend
 
@@ -66,6 +66,27 @@ _GAP = MAX_URL + len(PATTERN)  # zero gap between files: no cross-file
                                # into the next file (reference scans each
                                # file separately)
 _BS = 4096                     # rows per lax.map step in the window stage
+
+
+def _floor_pow2(n: int) -> int:
+    return 1 << (max(1, n).bit_length() - 1)
+
+
+def _env_knobs():
+    """On-chip A/B knobs, read at BUILDER-call time — outside every
+    lru_cache/jit cache, so toggling one of these within a process takes
+    effect on the next run() instead of silently reusing the old trace:
+
+    MR_COMPACT       'scatter' (default) | 'searchsorted' compaction
+    MR_WINDOW_BS     rows per lax.map window step, floored to a power of
+                     two (caps are powers of two, so the reshape divides)
+    MR_MARK_PAGE_WORDS  Pallas mark page size (ops/pallas/match.py)
+    """
+    compact = os.environ.get("MR_COMPACT", "scatter")
+    bs = _floor_pow2(int(os.environ.get("MR_WINDOW_BS", _BS)))
+    page_words = int(os.environ.get("MR_MARK_PAGE_WORDS",
+                                    MARK_PAGE_WORDS))
+    return compact, bs, page_words
 
 
 def _build_corpus(files: Sequence[str]):
@@ -98,7 +119,6 @@ def _build_corpus(files: Sequence[str]):
 _W_SHORT = 16      # 64-byte first-tier URL window (covers typical URLs)
 
 
-@functools.lru_cache(maxsize=None)
 def _extract_fn(cap: int, use_pallas: bool, interpret: bool):
     """The fused map stage (see module docstring).  jit re-specialises per
     (corpus words, nfiles) shape; `cap` is the static hit capacity.
@@ -109,23 +129,24 @@ def _extract_fn(cap: int, use_pallas: bool, interpret: bool):
     rows whose closing quote was not in the first window.  A long-tail
     overflow (more than cap/4 such rows) is returned so the caller can
     retry with the full window for every row."""
-    return _extract_build(cap, use_pallas, interpret, wide=False)
+    return _extract_build(cap, use_pallas, interpret, False, *_env_knobs())
 
 
-@functools.lru_cache(maxsize=None)
 def _extract_wide_fn(cap: int, use_pallas: bool, interpret: bool):
     """Fallback: full 256-byte windows for every row (used when the
     long-tail capacity overflows — long-URL-dense corpora)."""
-    return _extract_build(cap, use_pallas, interpret, wide=True)
+    return _extract_build(cap, use_pallas, interpret, True, *_env_knobs())
 
 
 def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
-                  interpret: bool, wide: bool):
+                  interpret: bool, wide: bool, compact: str = "scatter",
+                  bs: int = _BS, page_words: int = MARK_PAGE_WORDS):
     """The fused map-stage computation over ONE shard's corpus words.
     Shared by the single-device jit (_extract_build) and the mesh SPMD
     program (_extract_mesh_fn) — identical math, so the tiers and the
-    mesh shards produce bit-identical ids."""
-    bs = min(_BS, cap)
+    mesh shards produce bit-identical ids.  compact/bs/page_words are
+    the A/B knobs (_env_knobs) — part of every builder cache key."""
+    bs = min(_floor_pow2(bs), cap)
     nw = MAX_URL // 4
     w1 = nw if wide else _W_SHORT
     cap_long = max(8, cap // 4)
@@ -141,9 +162,10 @@ def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
 
     m = words.shape[0]
     nbytes = 4 * m
-    wmask = (mark_words_pallas(words, PATTERN, interpret=interpret)
+    wmask = (mark_words_pallas(words, PATTERN, interpret=interpret,
+                               page_words=page_words)
              if use_pallas else mark_words_xla(words, PATTERN))
-    starts, nhits = compact_word_matches(wmask, nbytes, cap)
+    starts, nhits = compact_word_matches(wmask, nbytes, cap, mode=compact)
     ustarts = starts + np.int32(len(PATTERN))
 
     def body(st):
@@ -205,15 +227,27 @@ def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
             pack(ustarts), pack(lengths), nhits, npairs, ncoll, nlong)
 
 
-def _extract_build(cap: int, use_pallas: bool, interpret: bool, wide: bool):
+@functools.lru_cache(maxsize=None)
+def _extract_build(cap: int, use_pallas: bool, interpret: bool,
+                   wide: bool = False, compact: str = "scatter",
+                   bs: int = _BS, page_words: int = MARK_PAGE_WORDS):
     return jax.jit(functools.partial(
         _extract_core, cap=cap, use_pallas=use_pallas,
-        interpret=interpret, wide=wide))
+        interpret=interpret, wide=wide, compact=compact, bs=bs,
+        page_words=page_words))
+
+
+def _extract_mesh_fn(mesh, cap: int, use_pallas: bool, interpret: bool,
+                     wide: bool):
+    """Per-device ingestion (VERDICT r2 #2) — see _extract_mesh_build;
+    this uncached wrapper resolves the A/B env knobs into the cache key."""
+    return _extract_mesh_build(mesh, cap, use_pallas, interpret, wide,
+                               *_env_knobs())
 
 
 @functools.lru_cache(maxsize=None)
-def _extract_mesh_fn(mesh, cap: int, use_pallas: bool, interpret: bool,
-                     wide: bool):
+def _extract_mesh_build(mesh, cap: int, use_pallas: bool, interpret: bool,
+                        wide: bool, compact: str, bs: int, page_words: int):
     """Per-device ingestion (VERDICT r2 #2): ONE SPMD program runs the
     fused extract on every shard's own corpus block — the reference's
     'each rank maps its own files on its own GPU'
@@ -229,16 +263,20 @@ def _extract_mesh_fn(mesh, cap: int, use_pallas: bool, interpret: bool,
         (ids, alts, docs, ustarts, lengths, nhits, npairs, ncoll,
          nlong) = _extract_core(words, fstarts, cap=cap,
                                 use_pallas=use_pallas,
-                                interpret=interpret, wide=wide)
+                                interpret=interpret, wide=wide,
+                                compact=compact, bs=bs,
+                                page_words=page_words)
         docs = docs + base[0].astype(jnp.uint32)
-        one = lambda x: x.reshape(1)
-        return (ids, alts, docs, ustarts, lengths, one(nhits),
-                one(npairs), one(ncoll), one(nlong))
+        # ONE [4] stats vector per shard: the cap-retry loop pulls it with
+        # a single device_get instead of four per-array transfers — over
+        # the tunnel each round-trip sits inside the TIMED map stage
+        stats = jnp.stack([nhits, npairs, ncoll, nlong]).astype(jnp.int32)
+        return (ids, alts, docs, ustarts, lengths, stats)
 
     # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
     # annotation, which the checker would otherwise reject
     sm = jax.shard_map(body, mesh=mesh, in_specs=(rspec, rspec, rspec),
-                       out_specs=(rspec,) * 9, check_vma=False)
+                       out_specs=(rspec,) * 6, check_vma=False)
     return jax.jit(sm)
 
 
@@ -456,6 +494,13 @@ class InvertedIndex:
                                f"{native.build_error()}")
         self.engine = engine
         self.use_pallas = engine == "pallas"
+        bb = os.environ.get("MR_BATCH_BYTES")
+        if bb:
+            # lowered per-corpus cap: proves the multi-batch ingestion
+            # machinery on a flaky tunnel without shipping 2 GiB through
+            # it.  LOWER-only: raising past the class cap would overflow
+            # the int32 byte offsets the 1<<30 invariant protects.
+            self._BATCH_BYTES = min(int(bb), InvertedIndex._BATCH_BYTES)
         if interpret is None:
             # CPU tests interpret the kernel; real hardware (including the
             # axon plugin backend) must compile via Mosaic — interpret mode
@@ -745,11 +790,10 @@ class InvertedIndex:
                 while True:
                     fn = _extract_mesh_fn(mesh, cap, self.use_pallas,
                                           self.interpret, wide)
-                    (ids, alts, docs, ustarts, lengths, nhits, npairs,
-                     ncoll, nlong) = fn(words_g, fstarts_g, base_g)
-                    nhits_h, npairs_h, ncoll_h, nlong_h = map(
-                        np.asarray,
-                        jax.device_get((nhits, npairs, ncoll, nlong)))
+                    (ids, alts, docs, ustarts, lengths,
+                     stats_g) = fn(words_g, fstarts_g, base_g)
+                    nhits_h, npairs_h, ncoll_h, nlong_h = \
+                        np.asarray(jax.device_get(stats_g)).reshape(P, 4).T
                     mx = int(nhits_h.max())
                     self.stats["nlong_max"] = max(self.stats["nlong_max"],
                                                   int(nlong_h.max()))

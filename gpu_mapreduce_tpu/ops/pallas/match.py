@@ -311,7 +311,8 @@ def _mark_words_call(words, masks, vals, interpret: bool):
     return out.reshape(-1)[:m]
 
 
-def compact_word_matches(wmask, nbytes: int, max_hits: int):
+def compact_word_matches(wmask, nbytes: int, max_hits: int,
+                         mode: str | None = None):
     """Word mask → sorted byte start offsets [max_hits] (fill = nbytes,
     i.e. positively out of range) + match count.
 
@@ -319,7 +320,24 @@ def compact_word_matches(wmask, nbytes: int, max_hits: int):
     (cuda/InvertedIndex.cu:321-362) in XLA terms.  NOT jnp.nonzero: its
     TPU lowering runs ~20× slower than this two-op form at 16M words
     (measured on v5e; nonzero sorts where a prefix-sum + scatter-with-drop
-    suffices, since scatter positions here are unique by construction)."""
+    suffices, since scatter positions here are unique by construction).
+
+    mode='searchsorted' (or MR_COMPACT=searchsorted when mode is None)
+    selects the gather-side dual (below) for on-chip A/B: same cumsum,
+    but each OUTPUT slot binary-searches its hit — max_hits·log m
+    gathered lanes instead of an m-element scatter.  Bit-identical by
+    construction (oracle test runs both).  NOTE: the env fallback reads
+    at TRACE time — callers inside cached/jitted builders must pass
+    mode explicitly (apps/invertedindex.py threads it through
+    _env_knobs into every builder cache key)."""
+    if mode is None:
+        mode = os.environ.get("MR_COMPACT", "scatter")
+    if mode not in ("scatter", "searchsorted"):
+        # a typo'd A/B label must error, not silently measure scatter
+        raise ValueError(f"MR_COMPACT/mode {mode!r}: "
+                         f"expected 'scatter' or 'searchsorted'")
+    if mode == "searchsorted":
+        return _compact_searchsorted(wmask, nbytes, max_hits)
     m = wmask.shape[0]
     hit = wmask > 0
     pos = jnp.cumsum(hit.astype(jnp.int32)) - 1
@@ -329,6 +347,23 @@ def compact_word_matches(wmask, nbytes: int, max_hits: int):
     starts = jnp.full(max_hits, nbytes, jnp.int32).at[tgt].set(
         start_of_word, mode="drop")
     return starts, jnp.sum(hit.astype(jnp.int32))
+
+
+def _compact_searchsorted(wmask, nbytes: int, max_hits: int):
+    """Gather-side compaction: slot j finds the (j+1)-th hit via binary
+    search over the hit-count prefix sum.  Replaces the 64M-element
+    scatter with max_hits·ceil(log2 m) random 4-byte reads — the right
+    trade when XLA's TPU scatter lowering dominates the map stage."""
+    m = wmask.shape[0]
+    hit = wmask > 0
+    c = jnp.cumsum(hit.astype(jnp.int32))
+    total = c[m - 1]
+    j = jnp.arange(1, max_hits + 1, dtype=jnp.int32)
+    idx = jnp.searchsorted(c, j, side="left").astype(jnp.int32)
+    safe = jnp.minimum(idx, m - 1)
+    starts = 4 * idx + jnp.take(wmask, safe).astype(jnp.int32) - 1
+    starts = jnp.where(j <= total, starts, jnp.int32(nbytes))
+    return starts, total
 
 
 # ---------------------------------------------------------------------------

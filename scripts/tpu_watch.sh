@@ -4,10 +4,11 @@
 #   1. scripts/mosaic_proof.py   -> MOSAIC_PROOF.json (+ .hlo.txt)
 #   2. bench.py                  -> BENCH_TPU_CAPTURE.json (headline)
 #   3. scripts/tpu_profile_map.py-> TPU_MAP_PROFILE.json (map breakdown)
-#   4. bench.py BENCH_MB=2048 BENCH_SKEW=1 -> published at-volume row
-#   5. BENCH_ENGINE=xla          -> engine-comparison row
-#   6. BENCH_DENSE               -> stress row (cap retry / wide fallback)
-#   7. soak.py                   -> BASELINE.json published.soak_<backend>
+#   4. BENCH_ENGINE=xla          -> engine-comparison row
+#   5. BENCH_DENSE               -> stress row (cap retry / wide fallback)
+#   6. soak.py                   -> BASELINE.json published.soak_<backend>
+#   7. bench.py BENCH_MB=640 MR_BATCH_BYTES=335544320 BENCH_SKEW=1 -> at-volume
+#      row sized to fit a short window (multi-batch + skew + long tail)
 #   8. scripts/pallas_debug.py   -> PALLAS_DEBUG.json size ladder
 # Every probe attempt is appended to the IN-REPO log TPU_PROBE_LOG.txt.
 #
@@ -105,18 +106,6 @@ while true; do
       [ $rc -eq 0 ] && grep -q '"full"' TPU_MAP_PROFILE.json 2>/dev/null \
         && touch /tmp/map_profile_done
     fi
-    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
-      BENCH_MB=2048 BENCH_SKEW=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
-        run_step bench_scale 5400 python bench.py \
-        >/tmp/bench_tpu_scale.out 2>/tmp/bench_tpu_scale.err
-      rc=$?
-      echo "$(date -u +%FT%TZ) bench-scale rc=$rc $(tail -c 200 /tmp/bench_tpu_scale.out)" >>"$PROBELOG"
-      if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_scale.out; then
-        if python scripts/record_scale.py /tmp/bench_tpu_scale.out /tmp/bench_tpu_scale.err >>"$LOG" 2>&1; then
-          touch /tmp/bench_scale_done
-        fi
-      fi
-    fi
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_xla_done ]; then
       BENCH_ENGINE=xla BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
         run_step bench_xla 3600 python bench.py \
@@ -148,6 +137,21 @@ while true; do
       echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$PROBELOG"
       if [ $rc -eq 0 ] && grep -Eq 'soak_(tpu|axon)' BASELINE.json; then
         SOAK_OK=1
+      fi
+    fi
+    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
+      # 640 MB with a 320 MB batch cap: the same multi-batch + skew + long-
+      # tail machinery as the 2 GiB CPU row, sized to fit a short tunnel
+      # window (2 GiB never survived one)
+      BENCH_MB=640 MR_BATCH_BYTES=335544320 BENCH_SKEW=1 BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
+        run_step bench_scale 3600 python bench.py \
+        >/tmp/bench_tpu_scale.out 2>/tmp/bench_tpu_scale.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) bench-scale rc=$rc $(tail -c 200 /tmp/bench_tpu_scale.out)" >>"$PROBELOG"
+      if [ $rc -eq 0 ] && grep -Eq '"backend": "(tpu|axon)"' /tmp/bench_tpu_scale.out; then
+        if python scripts/record_scale.py /tmp/bench_tpu_scale.out /tmp/bench_tpu_scale.err >>"$LOG" 2>&1; then
+          touch /tmp/bench_scale_done
+        fi
       fi
     fi
     DBG_TRIES=$(cat /tmp/pallas_debug_tries 2>/dev/null || echo 0)

@@ -83,6 +83,26 @@ def test_mark_words_pallas_paged_matches_single(rng):
     np.testing.assert_array_equal(np.sort(st[st < n]), _byte_oracle(buf))
 
 
+def test_compact_searchsorted_matches_scatter(rng, monkeypatch):
+    """The MR_COMPACT=searchsorted gather-side dual must be bit-identical
+    to the scatter compaction — including cap overflow and empty masks."""
+    n = 131072 * 4 + 64
+    buf = _planted_buffer(rng, n, (3, 508, 131067, n - 40))
+    words = jnp.asarray(bytes_view_u32(buf))
+    wm = mark_words_xla(words, PATTERN)
+    for cap in (64, 2):   # plenty of room / overflowing the cap
+        s1, c1 = compact_word_matches(wm, n, cap)
+        monkeypatch.setenv("MR_COMPACT", "searchsorted")
+        s2, c2 = compact_word_matches(wm, n, cap)
+        monkeypatch.delenv("MR_COMPACT")
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        assert int(c1) == int(c2)
+    empty = jnp.zeros(1024, jnp.int8)
+    monkeypatch.setenv("MR_COMPACT", "searchsorted")
+    s, c = compact_word_matches(empty, 4096, 8)
+    assert int(c) == 0 and (np.asarray(s) == 4096).all()
+
+
 def test_word_mask_agrees_with_byte_mask(rng):
     buf = _planted_buffer(rng, 4096, (7, 130, 1001))
     words = jnp.asarray(bytes_view_u32(buf))
