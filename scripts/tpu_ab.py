@@ -1,0 +1,105 @@
+"""On-chip A/B matrix for the fused map stage — one process, one corpus.
+
+Times _extract_build at the bench shape under each knob combination
+(compaction variant x window batch rows x mark page words; the knobs are
+lru_cache keys since r4, so every variant builds its own trace).  The
+corpus is synthesised and H2D-transferred ONCE — each extra variant costs
+its compile plus 3 timed reps, so the whole matrix fits a short tunnel
+window where N bench.py invocations would not.
+
+Writes TPU_AB.json, flushed after every variant (partial matrices survive
+a mid-run tunnel drop).  Diagnostic only: publishes nothing.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = "/root/repo"
+sys.path.insert(0, REPO)
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    from gpu_mapreduce_tpu.utils.platform import pin_platform
+    pin_platform("cpu")
+
+VARIANTS = [
+    # (compact, window_bs, page_words) — scatter/4096/4M is the shipped
+    # default; each other row moves ONE knob off the default
+    ("scatter", 4096, 1 << 22),
+    ("searchsorted", 4096, 1 << 22),
+    ("scatter", 32768, 1 << 22),
+    ("scatter", 4096, 1 << 23),
+    ("searchsorted", 32768, 1 << 22),   # both hot-knob winners combined
+]
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+    import bench
+    bench.enable_compilation_cache()
+    from gpu_mapreduce_tpu.apps import invertedindex as ii
+    from gpu_mapreduce_tpu.ops.pallas import match as mt
+
+    mb = int(os.environ.get("AB_MB", "256"))
+    rec = {"backend": jax.default_backend(),
+           "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "mb": mb, "runs": []}
+    interp = jax.default_backend() == "cpu"
+
+    def flush():
+        with open(f"{REPO}/TPU_AB.json", "w") as f:
+            json.dump(rec, f, indent=1)
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        paths, nurls, _ = bench.make_corpus(tmpdir, mb)
+        corpus, fstarts = ii._build_corpus(paths)
+    words = jnp.asarray(mt.bytes_view_u32(corpus))
+    fst = jnp.asarray(fstarts)
+    nbytes = int(corpus.shape[0])
+    del corpus
+    cap = max(8, 1 << (max(1, nbytes // 1024) - 1).bit_length())
+    rec["cap"] = cap
+    rec["nurls"] = nurls
+
+    base_npairs = None
+    for compact, bs, page in VARIANTS:
+        entry = {"compact": compact, "bs": bs, "page_words": page}
+        try:
+            fn = ii._extract_build(cap, True, interp, False,
+                                   compact, bs, page)
+            t0 = time.perf_counter()
+            out = fn(words, fst)
+            jax.block_until_ready(out)
+            entry["first_sec"] = round(time.perf_counter() - t0, 4)
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(words, fst)
+                jax.block_until_ready(out)
+            entry["warm_sec"] = round((time.perf_counter() - t0) / reps, 4)
+            entry["bytes_per_sec"] = round(nbytes / entry["warm_sec"], 1)
+            npairs = int(out[6])
+            entry["npairs"] = npairs
+            if base_npairs is None:
+                base_npairs = npairs
+            entry["ok"] = bool(npairs == base_npairs == nurls)
+        except Exception as e:  # noqa: BLE001 - record and continue
+            entry["ok"] = False
+            entry["error"] = repr(e)[:400]
+        rec["runs"].append(entry)
+        flush()
+        print(json.dumps(entry), flush=True)
+    best = min((r for r in rec["runs"] if r.get("ok")),
+               key=lambda r: r["warm_sec"], default=None)
+    rec["best"] = best
+    flush()
+    print(json.dumps({"best": best}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,8 @@
 #   6. soak.py                   -> BASELINE.json published.soak_<backend>
 #   7. bench.py BENCH_MB=640 MR_BATCH_BYTES=335544320 BENCH_SKEW=1 -> at-volume
 #      row sized to fit a short window (multi-batch + skew + long tail)
-#   8. scripts/pallas_debug.py   -> PALLAS_DEBUG.json size ladder
+#   8. scripts/tpu_ab.py          -> TPU_AB.json knob matrix (diagnostic)
+#   9. scripts/pallas_debug.py   -> PALLAS_DEBUG.json size ladder
 # Every probe attempt is appended to the IN-REPO log TPU_PROBE_LOG.txt.
 #
 # r4 second-window lesson: the tunnel can drop BETWEEN steps, and the
@@ -153,6 +154,16 @@ while true; do
           touch /tmp/bench_scale_done
         fi
       fi
+    fi
+    if [ -f /tmp/bench_scale_done ] && [ ! -f /tmp/tpu_ab_done ]; then
+      # knob matrix (diagnostic, unpublished): corpus + H2D paid once,
+      # each variant = compile + 3 timed reps -> TPU_AB.json
+      run_step tpu_ab 2400 python scripts/tpu_ab.py \
+        >/tmp/tpu_ab.out 2>/tmp/tpu_ab.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) tpu_ab rc=$rc $(tail -c 300 /tmp/tpu_ab.out)" >>"$PROBELOG"
+      [ $rc -eq 0 ] && grep -q '"best"' TPU_AB.json 2>/dev/null \
+        && touch /tmp/tpu_ab_done
     fi
     DBG_TRIES=$(cat /tmp/pallas_debug_tries 2>/dev/null || echo 0)
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/pallas_debug_done ] \
