@@ -79,6 +79,9 @@ run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
 while true; do
   if probe_ok; then
     echo "$(date -u +%FT%TZ) probe OK (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$PROBELOG"
+    # an idle machine for the window: pause any running test suites (the
+    # 03:22Z capture recorded read=16s for 256MB under a pytest run)
+    pkill -STOP -f "python -m pytest" 2>/dev/null
     if [ "$PROOF_OK" = 0 ]; then
       run_step mosaic_proof 900 python scripts/mosaic_proof.py \
         >/tmp/mosaic_proof.out 2>/tmp/mosaic_proof.err
@@ -179,8 +182,10 @@ while true; do
         && [ -f /tmp/bench_scale_done ]; then
       touch /tmp/tpu_captured.flag
       echo "$(date -u +%FT%TZ) ALL records captured on TPU" >>"$PROBELOG"
+      pkill -CONT -f "python -m pytest" 2>/dev/null
       exit 0
     fi
+    pkill -CONT -f "python -m pytest" 2>/dev/null
   else
     echo "$(date -u +%FT%TZ) probe FAIL (timeout/backend-not-tpu)" >>"$PROBELOG"
   fi
