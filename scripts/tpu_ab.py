@@ -13,7 +13,6 @@ a mid-run tunnel drop).  Diagnostic only: publishes nothing.
 import json
 import os
 import sys
-import tempfile
 import time
 
 REPO = "/root/repo"
@@ -54,9 +53,8 @@ def main() -> int:
         with open(f"{REPO}/TPU_AB.json", "w") as f:
             json.dump(rec, f, indent=1)
 
-    with tempfile.TemporaryDirectory() as tmpdir:
-        paths, nurls, _ = bench.make_corpus(tmpdir, mb)
-        corpus, fstarts = ii._build_corpus(paths)
+    paths, nurls, _ = bench.corpus_cached(mb, False, False)
+    corpus, fstarts = ii._build_corpus(paths)
     words = jnp.asarray(mt.bytes_view_u32(corpus))
     fst = jnp.asarray(fstarts)
     nbytes = int(corpus.shape[0])

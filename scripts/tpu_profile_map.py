@@ -20,7 +20,6 @@ import functools
 import json
 import os
 import sys
-import tempfile
 import time
 
 REPO = "/root/repo"
@@ -65,9 +64,8 @@ def main() -> int:
         with open(f"{REPO}/TPU_MAP_PROFILE.json", "w") as f:
             json.dump(rec, f, indent=1)
 
-    with tempfile.TemporaryDirectory() as tmpdir:
-        paths, nurls, _ = bench.make_corpus(tmpdir, rec["mb"])
-        corpus, fstarts = ii._build_corpus(paths)
+    paths, nurls, _ = bench.corpus_cached(rec["mb"], False, False)
+    corpus, fstarts = ii._build_corpus(paths)
     words = jnp.asarray(mt.bytes_view_u32(corpus))
     nbytes = int(corpus.shape[0])
     del corpus
