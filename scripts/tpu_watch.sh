@@ -38,8 +38,11 @@ cpu_ticks() {  # utime+stime ticks of pid $1 and all its descendants
   echo $total
 }
 
-probe_ok() {
-  timeout 240 python -c \
+probe_ok() {  # probe_ok [timeout]: live tunnels answer in ~10-40s; a
+  # DOWN tunnel burns the whole timeout, so the scan loop probes fast
+  # (90s) to shrink the window-miss gap, while per-step re-probes keep
+  # the patient 240s
+  timeout "${1:-240}" python -c \
     "import jax; b = jax.default_backend(); assert b in ('tpu','axon'), b" \
     2>>"$LOG"
 }
@@ -77,7 +80,7 @@ run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
 }
 
 while true; do
-  if probe_ok; then
+  if probe_ok 90; then
     echo "$(date -u +%FT%TZ) probe OK (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$PROBELOG"
     # an idle machine for the window: pause any running test suites (the
     # 03:22Z capture recorded read=16s for 256MB under a pytest run)
@@ -190,5 +193,5 @@ while true; do
     echo "$(date -u +%FT%TZ) probe FAIL (timeout/backend-not-tpu)" >>"$PROBELOG"
   fi
   echo "$(date -u +%FT%TZ) loop (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$LOG"
-  sleep 240
+  sleep 90
 done
