@@ -77,7 +77,7 @@ def _env_knobs():
     lru_cache/jit cache, so toggling one of these within a process takes
     effect on the next run() instead of silently reusing the old trace:
 
-    MR_COMPACT       'scatter' (default) | 'searchsorted' compaction
+    MR_COMPACT       'scatter' (default) | 'searchsorted' | 'blocked'
     MR_WINDOW_BS     rows per lax.map window step, floored to a power of
                      two (caps are powers of two, so the reshape divides)
     MR_MARK_PAGE_WORDS  Pallas mark page size (ops/pallas/match.py)

@@ -322,22 +322,25 @@ def compact_word_matches(wmask, nbytes: int, max_hits: int,
     (measured on v5e; nonzero sorts where a prefix-sum + scatter-with-drop
     suffices, since scatter positions here are unique by construction).
 
-    mode='searchsorted' (or MR_COMPACT=searchsorted when mode is None)
-    selects the gather-side dual (below) for on-chip A/B: same cumsum,
-    but each OUTPUT slot binary-searches its hit — max_hits·log m
-    gathered lanes instead of an m-element scatter.  Bit-identical by
-    construction (oracle test runs both).  NOTE: the env fallback reads
+    mode (or MR_COMPACT when mode is None) selects among three
+    bit-identical variants for on-chip A/B: 'scatter' (this path),
+    'searchsorted' (each OUTPUT slot binary-searches the hit-count
+    prefix sum — max_hits·log m gathered lanes instead of an m-element
+    scatter), and 'blocked' (_compact_blocked: two-level scan, no
+    full-length major-axis cumsum at all).  NOTE: the env fallback reads
     at TRACE time — callers inside cached/jitted builders must pass
     mode explicitly (apps/invertedindex.py threads it through
     _env_knobs into every builder cache key)."""
     if mode is None:
         mode = os.environ.get("MR_COMPACT", "scatter")
-    if mode not in ("scatter", "searchsorted"):
+    if mode not in ("scatter", "searchsorted", "blocked"):
         # a typo'd A/B label must error, not silently measure scatter
-        raise ValueError(f"MR_COMPACT/mode {mode!r}: "
-                         f"expected 'scatter' or 'searchsorted'")
+        raise ValueError(f"MR_COMPACT/mode {mode!r}: expected "
+                         f"'scatter', 'searchsorted' or 'blocked'")
     if mode == "searchsorted":
         return _compact_searchsorted(wmask, nbytes, max_hits)
+    if mode == "blocked":
+        return _compact_blocked(wmask, nbytes, max_hits)
     m = wmask.shape[0]
     hit = wmask > 0
     pos = jnp.cumsum(hit.astype(jnp.int32)) - 1
@@ -347,6 +350,56 @@ def compact_word_matches(wmask, nbytes: int, max_hits: int,
     starts = jnp.full(max_hits, nbytes, jnp.int32).at[tgt].set(
         start_of_word, mode="drop")
     return starts, jnp.sum(hit.astype(jnp.int32))
+
+
+_BLOCK_C = 512   # lanes per row in the blocked compaction's 2-level scan
+
+
+def _compact_blocked(wmask, nbytes: int, max_hits: int):
+    """Hierarchical compaction: NO scan or scatter ever runs over the full
+    m words along the major axis.  The mask reshapes to [R, 512]; the
+    per-row prefix sum is a minor-axis cumsum (lane-parallel on the VPU),
+    the row totals scan is R = m/512 elements, and each output slot then
+    finds its hit with a two-level binary search (log R gathered lanes to
+    pick the row, log 512 within it).  The right trade when XLA's
+    full-length major-axis cumsum lowering dominates the map stage —
+    bit-identical to the scatter path (oracle test runs all three)."""
+    m = wmask.shape[0]
+    C = _BLOCK_C
+    pad = (-m) % C
+    hit = (wmask > 0).astype(jnp.int32)
+    if pad:
+        hit = jnp.concatenate([hit, jnp.zeros(pad, jnp.int32)])
+    R = hit.shape[0] // C
+    intra = jnp.cumsum(hit.reshape(R, C), axis=1)        # [R, C] minor axis
+    row_tot = intra[:, C - 1]
+    row_off = jnp.cumsum(row_tot)                        # [R] inclusive
+    total = row_off[R - 1]
+    j = jnp.arange(1, max_hits + 1, dtype=jnp.int32)
+    row = jnp.searchsorted(row_off, j, side="left").astype(jnp.int32)
+    rsafe = jnp.minimum(row, R - 1)
+    prev = jnp.where(row > 0,
+                     jnp.take(row_off, jnp.maximum(rsafe - 1, 0)),
+                     jnp.int32(0))
+    r = j - prev                                         # rank within row
+    flat = intra.reshape(-1)
+    lo = jnp.zeros(max_hits, jnp.int32)
+    hi = jnp.full(max_hits, C, jnp.int32)
+    # lower_bound over a size-C range converges in bit_length(C) guarded
+    # steps (the last resolves the final length-1 interval; converged
+    # lanes are no-ops under the lo<hi guard)
+    for _ in range(C.bit_length()):
+        upd = lo < hi
+        mid = (lo + hi) // 2
+        v = jnp.take(flat, jnp.minimum(rsafe * C + mid, R * C - 1))
+        ge = v >= r
+        hi = jnp.where(upd & ge, mid, hi)
+        lo = jnp.where(upd & ~ge, mid + 1, lo)
+    word = rsafe * C + lo
+    wsafe = jnp.minimum(word, m - 1)
+    starts = 4 * word + jnp.take(wmask, wsafe).astype(jnp.int32) - 1
+    starts = jnp.where(j <= total, starts, jnp.int32(nbytes))
+    return starts, total
 
 
 def _compact_searchsorted(wmask, nbytes: int, max_hits: int):

@@ -27,9 +27,10 @@ VARIANTS = [
     # default; each other row moves ONE knob off the default
     ("scatter", 4096, 1 << 22),
     ("searchsorted", 4096, 1 << 22),
+    ("blocked", 4096, 1 << 22),
     ("scatter", 32768, 1 << 22),
     ("scatter", 4096, 1 << 23),
-    ("searchsorted", 32768, 1 << 22),   # both hot-knob winners combined
+    ("searchsorted", 32768, 1 << 22),   # hot-knob winners combined
 ]
 
 

@@ -83,24 +83,36 @@ def test_mark_words_pallas_paged_matches_single(rng):
     np.testing.assert_array_equal(np.sort(st[st < n]), _byte_oracle(buf))
 
 
-def test_compact_searchsorted_matches_scatter(rng, monkeypatch):
-    """The MR_COMPACT=searchsorted gather-side dual must be bit-identical
-    to the scatter compaction — including cap overflow and empty masks."""
+@pytest.mark.parametrize("alt", ["searchsorted", "blocked"])
+def test_compact_variants_match_scatter(rng, alt, monkeypatch):
+    """The searchsorted gather-side dual and the blocked two-level-scan
+    variant must be bit-identical to the scatter compaction — including
+    cap overflow, empty masks, and (for blocked) hits straddling its
+    row seams and landing in the final ragged row."""
+    from gpu_mapreduce_tpu.ops.pallas.match import _BLOCK_C
     n = 131072 * 4 + 64
-    buf = _planted_buffer(rng, n, (3, 508, 131067, n - 40))
+    seam = _BLOCK_C * 4   # one blocked row, in bytes
+    buf = _planted_buffer(rng, n,
+                          (3, seam - 2, 7 * seam + 11, 131067, n - 40))
     words = jnp.asarray(bytes_view_u32(buf))
     wm = mark_words_xla(words, PATTERN)
     for cap in (64, 2):   # plenty of room / overflowing the cap
-        s1, c1 = compact_word_matches(wm, n, cap)
-        monkeypatch.setenv("MR_COMPACT", "searchsorted")
-        s2, c2 = compact_word_matches(wm, n, cap)
-        monkeypatch.delenv("MR_COMPACT")
+        s1, c1 = compact_word_matches(wm, n, cap, mode="scatter")
+        s2, c2 = compact_word_matches(wm, n, cap, mode=alt)
         np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
         assert int(c1) == int(c2)
+    # the MR_COMPACT env fallback (mode=None) must route identically
+    monkeypatch.setenv("MR_COMPACT", alt)
+    s3, c3 = compact_word_matches(wm, n, 64)
+    np.testing.assert_array_equal(
+        np.asarray(s3), np.asarray(compact_word_matches(wm, n, 64,
+                                                        mode=alt)[0]))
+    monkeypatch.delenv("MR_COMPACT")
     empty = jnp.zeros(1024, jnp.int8)
-    monkeypatch.setenv("MR_COMPACT", "searchsorted")
-    s, c = compact_word_matches(empty, 4096, 8)
+    s, c = compact_word_matches(empty, 4096, 8, mode=alt)
     assert int(c) == 0 and (np.asarray(s) == 4096).all()
+    with pytest.raises(ValueError, match="expected"):
+        compact_word_matches(empty, 4096, 8, mode="searchsort")
 
 
 def test_word_mask_agrees_with_byte_mask(rng):
