@@ -83,10 +83,17 @@ def _env_knobs():
     MR_MARK_PAGE_WORDS  Pallas mark page size (ops/pallas/match.py)
     """
     compact = os.environ.get("MR_COMPACT", "scatter")
-    bs = _floor_pow2(int(os.environ.get("MR_WINDOW_BS", _BS)))
+    bs_raw = int(os.environ.get("MR_WINDOW_BS", _BS))
     page_words = int(os.environ.get("MR_MARK_PAGE_WORDS",
                                     MARK_PAGE_WORDS))
-    return compact, bs, page_words
+    # fail FAST on nonsense values, like MR_COMPACT does on a typo — a
+    # zero page size would only surface as a ZeroDivisionError deep in
+    # the mark paging and silently mismeasure an A/B run (ADVICE r4)
+    if bs_raw <= 0:
+        raise ValueError(f"MR_WINDOW_BS={bs_raw}: must be > 0")
+    if page_words <= 0:
+        raise ValueError(f"MR_MARK_PAGE_WORDS={page_words}: must be > 0")
+    return compact, _floor_pow2(bs_raw), page_words
 
 
 def _build_corpus(files: Sequence[str]):
@@ -340,6 +347,8 @@ def _h2d_sharded(words_host, W: int, P: int, sharding):
     buffers, each transferred to its own device in ≤H2D_CHUNK_WORDS
     messages (no [P*W] host concatenation, no unbounded single transfer)."""
     chunk_w = int(os.environ.get("MR_H2D_CHUNK_WORDS", H2D_CHUNK_WORDS))
+    if chunk_w <= 0:
+        raise ValueError(f"MR_H2D_CHUNK_WORDS={chunk_w}: must be > 0")
     dmap = sharding.addressable_devices_indices_map((P * W,))
     shards = []
     for dev, idx in dmap.items():

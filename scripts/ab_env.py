@@ -1,0 +1,34 @@
+"""Print shell exports for the measured-best extract knobs.
+
+Reads TPU_AB.json; if (and only if) it holds an on-chip matrix
+(backend tpu/axon) with a green `best` row, prints ONE line:
+
+    export MR_COMPACT=... MR_WINDOW_BS=... MR_MARK_PAGE_WORDS=...
+
+so the watcher can `eval "$(python scripts/ab_env.py)"` before the
+headline bench — the round-4 verdict's "flip knob defaults per the
+measured winner" applied automatically the moment the measurement
+exists.  Prints nothing (exit 0) when there is no on-chip best row:
+stale CPU-interpret matrices must not steer the chip.
+"""
+import json
+import sys
+
+def main() -> int:
+    try:
+        with open("/root/repo/TPU_AB.json") as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    if rec.get("backend") not in ("tpu", "axon"):
+        return 0
+    best = rec.get("best")
+    if not best or not best.get("ok"):
+        return 0
+    print(f"export MR_COMPACT={best['compact']} "
+          f"MR_WINDOW_BS={int(best['bs'])} "
+          f"MR_MARK_PAGE_WORDS={int(best['page_words'])}")
+    return 0
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,18 +76,33 @@ def main() -> int:
     interp = jax.default_backend() == "cpu"   # CPU smoke runs interpret
     rec["interpret"] = interp
 
-    # mark (the paged Pallas kernel exactly as the engine runs it)
+    # mark (the paged Pallas kernel exactly as the engine runs it).
+    # page_words/mode are pinned to the SHIPPED defaults explicitly: the
+    # watcher exports the A/B winner's knobs after tpu_ab, and a retried
+    # profile run would otherwise silently measure the winner while
+    # labeled as the default (r5 review)
     mark = jax.jit(functools.partial(mt.mark_words_pallas, pattern=ii.PATTERN,
-                                     interpret=interp))
+                                     interpret=interp,
+                                     page_words=mt.MARK_PAGE_WORDS))
     rec["sections"]["mark"] = round(timed(mark, words), 4)
     flush()
 
-    # compact: cumsum + scatter-drop over the word mask
+    # compact: ALL THREE bit-identical variants timed in isolation — even
+    # a window that dies before the full-matrix A/B answers the round-5
+    # question "which compaction lowering holds the extract tail".
+    # "compact" keeps its historical meaning (the shipped scatter default).
     wmask = mark(words)
     comp = jax.jit(functools.partial(mt.compact_word_matches,
-                                     nbytes=nbytes, max_hits=cap))
+                                     nbytes=nbytes, max_hits=cap,
+                                     mode="scatter"))
     rec["sections"]["compact"] = round(timed(comp, wmask), 4)
     flush()
+    for variant in ("searchsorted", "blocked"):
+        cv = jax.jit(functools.partial(mt.compact_word_matches,
+                                       nbytes=nbytes, max_hits=cap,
+                                       mode=variant))
+        rec["sections"][f"compact_{variant}"] = round(timed(cv, wmask), 4)
+        flush()
 
     starts, _ = comp(wmask)
     ustarts = starts + np.int32(len(ii.PATTERN))
@@ -132,8 +147,11 @@ def main() -> int:
         timed(jax.jit(_pack), ids, alts, lens, starts), 4)
     flush()
 
-    # full fused dispatch — the engine's actual map_device program
-    fn = ii._extract_fn(cap, True, interp)
+    # full fused dispatch — the engine's map_device program at the
+    # SHIPPED default knobs (explicit: immune to the watcher's A/B-best
+    # env exports on a retried run)
+    fn = ii._extract_build(cap, True, interp, False, "scatter", ii._BS,
+                           mt.MARK_PAGE_WORDS)
     rec["sections"]["full"] = round(timed(fn, words, fst), 4)
     rec["full_bytes_per_sec"] = round(nbytes / rec["sections"]["full"], 1)
     flush()

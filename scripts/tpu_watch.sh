@@ -1,35 +1,67 @@
 #!/bin/bash
 # Watch the flaky axon TPU tunnel; the moment it answers, capture the
-# round's real-TPU records in CHEAPEST-FIRST order (VERDICT r3 #1):
-#   1. scripts/mosaic_proof.py   -> MOSAIC_PROOF.json (+ .hlo.txt)
-#   2. bench.py                  -> BENCH_TPU_CAPTURE.json (headline)
-#   3. scripts/tpu_profile_map.py-> TPU_MAP_PROFILE.json (map breakdown)
-#   4. BENCH_ENGINE=xla          -> engine-comparison row
-#   5. BENCH_DENSE               -> stress row (cap retry / wide fallback)
-#   6. soak.py                   -> BASELINE.json published.soak_<backend>
-#   7. bench.py BENCH_MB=640 MR_BATCH_BYTES=335544320 BENCH_SKEW=1 -> at-volume
-#      row sized to fit a short window (multi-batch + skew + long tail)
-#   8. scripts/tpu_ab.py          -> TPU_AB.json knob matrix (diagnostic)
-#   9. scripts/pallas_debug.py   -> PALLAS_DEBUG.json size ladder
+# round's real-TPU records.  ROUND-5 ORDER (VERDICT r4 #1: tuning data
+# FIRST, then the headline bench with the measured-best knobs applied):
+#   1. scripts/mosaic_proof.py    -> MOSAIC_PROOF.json (skip if captured)
+#   2. scripts/tpu_profile_map.py -> TPU_MAP_PROFILE.json (map breakdown,
+#      now incl. all three compaction variants in isolation)
+#   3. scripts/tpu_ab.py          -> TPU_AB.json knob matrix + best row
+#   4. bench.py                   -> BENCH_TPU_CAPTURE.json (headline),
+#      run under `eval $(scripts/ab_env.py)` — the measured-best knobs
+#   5. scripts/pallas_debug.py    -> PALLAS_DEBUG.json size ladder
+#      (root-cause of the r4 256MB single-dispatch failure)
+#   6. soak.py SOAK_SCALE=20 SOAK_PR_SCALE=22 -> soak_<backend> rows incl.
+#      the PageRank RMAT-22 north star
+#   7. BENCH_ENGINE=xla           -> engine-comparison row
+#   8. BENCH_DENSE                -> stress row (cap retry / wide fallback)
+#   9. bench.py BENCH_MB=640 MR_BATCH_BYTES=335544320 BENCH_SKEW=1 -> at-
+#      volume row sized to fit a short window (multi-batch + skew + tail)
 # Every probe attempt is appended to the IN-REPO log TPU_PROBE_LOG.txt.
 #
 # r4 second-window lesson: the tunnel can drop BETWEEN steps, and the
 # next step then hangs at backend init with ZERO cpu until its multi-hour
-# `timeout` expires (the 03:22Z 2GiB bench sat 37+ min at 0:27 cpu with
-# no corpus even generated).  run_step therefore (a) re-probes in a
-# throwaway subprocess before each step, (b) kills any step whose
-# cumulative cpu time advances <2s over a 420s stretch — a genuine
-# capture is either computing or transferring (the transfer loop burns
-# cpu serialising chunks); only a dead client sits at zero.
+# `timeout` expires.  run_step therefore (a) re-probes in a throwaway
+# subprocess before each step, (b) kills any step whose cumulative cpu
+# time advances <2s over a 420s stretch — a genuine capture is either
+# computing or transferring; only a dead client sits at zero.
 cd /root/repo || exit 1
 LOG=/tmp/tpu_watch.log
 PROBELOG=/root/repo/TPU_PROBE_LOG.txt
 PROOF_OK=0; BENCH_OK=0; SOAK_OK=0
 [ -f MOSAIC_PROOF.json ] && grep -q '"oracle_match": true' MOSAIC_PROOF.json && PROOF_OK=1
+# seed the /tmp done-flags from committed on-chip artifacts (a restart
+# with a clean /tmp must not wedge the completion gate — r5 review)
+grep -Eq '"backend": "(tpu|axon)"' TPU_MAP_PROFILE.json 2>/dev/null \
+  && grep -q '"full"' TPU_MAP_PROFILE.json && touch /tmp/map_profile_done
+# matrix_version guards against seeding from an older, smaller VARIANTS
+# set (the blocked rows must actually get measured — r5 review)
+grep -Eq '"backend": "(tpu|axon)"' TPU_AB.json 2>/dev/null \
+  && grep -q '"matrix_version": 2' TPU_AB.json \
+  && grep -q '"best": {' TPU_AB.json && touch /tmp/tpu_ab_done
+grep -Eq '"backend": "(tpu|axon)"' PALLAS_DEBUG.json 2>/dev/null \
+  && touch /tmp/pallas_debug_done
+
+descendants() {  # ALL transitive children of pid $1 (ADVICE r4: pgrep -P
+  # alone missed grandchildren, so a step working in a grandchild read
+  # as a CPU stall and was killed mid-capture)
+  local p
+  for p in $(pgrep -P "$1" 2>/dev/null); do
+    echo "$p"
+    descendants "$p"
+  done
+}
+
+kill_tree() {  # kill -$2 pid $1 AND every transitive descendant — a
+  # grandchild holding the TPU client must not survive a step kill and
+  # wedge the rest of the window (r5 review)
+  local sig=${2:-KILL} pids
+  pids="$1 $(descendants "$1")"
+  kill -"$sig" $pids 2>/dev/null
+}
 
 cpu_ticks() {  # utime+stime ticks of pid $1 and all its descendants
   local total=0 pid
-  for pid in $1 $(pgrep -P "$1" 2>/dev/null); do
+  for pid in $1 $(descendants "$1"); do
     if [ -r "/proc/$pid/stat" ]; then
       set -- $(cat "/proc/$pid/stat" 2>/dev/null)
       total=$((total + ${14:-0} + ${15:-0}))
@@ -45,6 +77,12 @@ probe_ok() {  # probe_ok [timeout]: live tunnels answer in ~10-40s; a
   timeout "${1:-240}" python -c \
     "import jax; b = jax.default_backend(); assert b in ('tpu','axon'), b" \
     2>>"$LOG"
+}
+
+on_chip() {  # on_chip <json-file>: true iff the artifact records a real
+  # chip backend — stale CPU-interpret captures of the same name must
+  # not mark a step done (they exist on disk from the r4 smoke runs)
+  grep -Eq '"backend": "(tpu|axon)"' "$1" 2>/dev/null
 }
 
 run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
@@ -63,15 +101,13 @@ run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
     elif [ $((now - last_adv)) -ge 420 ]; then
       echo "$(date -u +%FT%TZ) $name HUNG (cpu stalled ${ticks}t) — killed" \
         >>"$PROBELOG"
-      kill -TERM $pid 2>/dev/null; sleep 5; kill -KILL $pid 2>/dev/null
-      pkill -KILL -P $pid 2>/dev/null
+      kill_tree $pid TERM; sleep 5; kill_tree $pid KILL
       wait $pid 2>/dev/null
       return 8
     fi
     if [ $((now - t0)) -ge "$tmo" ]; then
       echo "$(date -u +%FT%TZ) $name TIMEOUT ${tmo}s — killed" >>"$PROBELOG"
-      kill -TERM $pid 2>/dev/null; sleep 5; kill -KILL $pid 2>/dev/null
-      pkill -KILL -P $pid 2>/dev/null
+      kill_tree $pid TERM; sleep 5; kill_tree $pid KILL
       wait $pid 2>/dev/null
       return 7
     fi
@@ -92,6 +128,29 @@ while true; do
       echo "$(date -u +%FT%TZ) mosaic_proof rc=$rc $(tail -c 400 /tmp/mosaic_proof.out)" >>"$PROBELOG"
       [ $rc -eq 0 ] && PROOF_OK=1
     fi
+    # -- 2. map-stage breakdown (the round-5 tuning input) -------------
+    if [ ! -f /tmp/map_profile_done ]; then
+      run_step map_profile 1800 python scripts/tpu_profile_map.py \
+        >/tmp/map_profile.out 2>/tmp/map_profile.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) map_profile rc=$rc $(tail -c 300 /tmp/map_profile.out)" >>"$PROBELOG"
+      [ $rc -eq 0 ] && on_chip TPU_MAP_PROFILE.json \
+        && grep -q '"full"' TPU_MAP_PROFILE.json && touch /tmp/map_profile_done
+    fi
+    # -- 3. knob matrix -> best row ('"best": {' — a null best row from
+    # an all-failed matrix must NOT mark the step done; r5 review) ----
+    if [ ! -f /tmp/tpu_ab_done ]; then
+      run_step tpu_ab 2700 python scripts/tpu_ab.py \
+        >/tmp/tpu_ab.out 2>/tmp/tpu_ab.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) tpu_ab rc=$rc $(tail -c 300 /tmp/tpu_ab.out)" >>"$PROBELOG"
+      [ $rc -eq 0 ] && on_chip TPU_AB.json && grep -q '"best": {' TPU_AB.json \
+        && touch /tmp/tpu_ab_done
+    fi
+    # measured-best knobs (no-op unless TPU_AB.json holds an on-chip
+    # green best row) — applied to the headline bench and every later row
+    eval "$(python scripts/ab_env.py 2>/dev/null)"
+    # -- 4. headline bench ---------------------------------------------
     if [ "$BENCH_OK" = 0 ]; then
       BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=2 \
         run_step bench 3600 python bench.py \
@@ -105,14 +164,29 @@ while true; do
           > /root/repo/BENCH_TPU_CAPTURE_DETAIL.json 2>/dev/null
       fi
     fi
-    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/map_profile_done ]; then
-      run_step map_profile 1800 python scripts/tpu_profile_map.py \
-        >/tmp/map_profile.out 2>/tmp/map_profile.err
+    # -- 5. root-cause ladder for the r4 256MB pallas failure ----------
+    DBG_TRIES=$(cat /tmp/pallas_debug_tries 2>/dev/null || echo 0)
+    if [ ! -f /tmp/pallas_debug_done ] && [ "$DBG_TRIES" -lt 3 ]; then
+      run_step pallas_debug 2400 python scripts/pallas_debug.py \
+        >/tmp/pallas_debug.out 2>/tmp/pallas_debug.err
       rc=$?
-      echo "$(date -u +%FT%TZ) map_profile rc=$rc $(tail -c 300 /tmp/map_profile.out)" >>"$PROBELOG"
-      [ $rc -eq 0 ] && grep -q '"full"' TPU_MAP_PROFILE.json 2>/dev/null \
-        && touch /tmp/map_profile_done
+      # a tunnel-gone skip (rc=9) must not burn the retry budget — the
+      # step never ran (r5 review)
+      [ $rc -ne 9 ] && echo $((DBG_TRIES + 1)) >/tmp/pallas_debug_tries
+      echo "$(date -u +%FT%TZ) pallas_debug rc=$rc $(tail -c 300 /tmp/pallas_debug.out)" >>"$PROBELOG"
+      [ $rc -eq 0 ] && on_chip PALLAS_DEBUG.json && touch /tmp/pallas_debug_done
     fi
+    # -- 6. graph-suite soak + PageRank RMAT-22 north star -------------
+    if [ "$SOAK_OK" = 0 ]; then
+      SOAK_SCALE="${SOAK_SCALE:-20}" SOAK_PR_SCALE="${SOAK_PR_SCALE:-22}" \
+        run_step soak 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
+      rc=$?
+      echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$PROBELOG"
+      if [ $rc -eq 0 ] && grep -Eq 'soak_(tpu|axon)' BASELINE.json; then
+        SOAK_OK=1
+      fi
+    fi
+    # -- 7-9. engine comparison, stress, at-volume ---------------------
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_xla_done ]; then
       BENCH_ENGINE=xla BENCH_PROBE_TIMEOUT=240 BENCH_PROBE_RETRIES=1 \
         run_step bench_xla 3600 python bench.py \
@@ -137,15 +211,6 @@ while true; do
         fi
       fi
     fi
-    if [ "$SOAK_OK" = 0 ] && [ "$BENCH_OK" = 1 ]; then
-      SOAK_SCALE="${SOAK_SCALE:-20}" \
-        run_step soak 5400 python soak.py >/tmp/soak_tpu.out 2>/tmp/soak_tpu.err
-      rc=$?
-      echo "$(date -u +%FT%TZ) soak rc=$rc" >>"$PROBELOG"
-      if [ $rc -eq 0 ] && grep -Eq 'soak_(tpu|axon)' BASELINE.json; then
-        SOAK_OK=1
-      fi
-    fi
     if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/bench_scale_done ]; then
       # 640 MB with a 320 MB batch cap: the same multi-batch + skew + long-
       # tail machinery as the 2 GiB CPU row, sized to fit a short tunnel
@@ -161,28 +226,9 @@ while true; do
         fi
       fi
     fi
-    if [ -f /tmp/bench_scale_done ] && [ ! -f /tmp/tpu_ab_done ]; then
-      # knob matrix (diagnostic, unpublished): corpus + H2D paid once,
-      # each variant = compile + 3 timed reps -> TPU_AB.json
-      run_step tpu_ab 2400 python scripts/tpu_ab.py \
-        >/tmp/tpu_ab.out 2>/tmp/tpu_ab.err
-      rc=$?
-      echo "$(date -u +%FT%TZ) tpu_ab rc=$rc $(tail -c 300 /tmp/tpu_ab.out)" >>"$PROBELOG"
-      [ $rc -eq 0 ] && grep -q '"best"' TPU_AB.json 2>/dev/null \
-        && touch /tmp/tpu_ab_done
-    fi
-    DBG_TRIES=$(cat /tmp/pallas_debug_tries 2>/dev/null || echo 0)
-    if [ "$BENCH_OK" = 1 ] && [ ! -f /tmp/pallas_debug_done ] \
-        && [ "$DBG_TRIES" -lt 3 ]; then
-      echo $((DBG_TRIES + 1)) >/tmp/pallas_debug_tries
-      run_step pallas_debug 2400 python scripts/pallas_debug.py \
-        >/tmp/pallas_debug.out 2>/tmp/pallas_debug.err
-      rc=$?
-      echo "$(date -u +%FT%TZ) pallas_debug rc=$rc $(tail -c 300 /tmp/pallas_debug.out)" >>"$PROBELOG"
-      [ $rc -eq 0 ] && [ -f PALLAS_DEBUG.json ] && touch /tmp/pallas_debug_done
-    fi
     if [ "$PROOF_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && [ "$SOAK_OK" = 1 ] \
-        && [ -f /tmp/bench_scale_done ]; then
+        && [ -f /tmp/bench_scale_done ] && [ -f /tmp/map_profile_done ] \
+        && [ -f /tmp/tpu_ab_done ]; then
       touch /tmp/tpu_captured.flag
       echo "$(date -u +%FT%TZ) ALL records captured on TPU" >>"$PROBELOG"
       pkill -CONT -f "python -m pytest" 2>/dev/null
