@@ -1,0 +1,59 @@
+"""wordfreq2 — the reference's second word-frequency driver
+(``examples/wordfreq2.cpp:60-140``): same map → collate → reduce(sum)
+pipeline as wordfreq, but the top-N prints TWICE — once from the
+locally-sorted data (the reference's per-proc pass, flag=0) and once
+globally after ``gather(1)`` + re-sort (flag=1).  The idiom shows that
+sort_values before a gather orders only within each proc's data, and
+that a global answer needs the gather.
+
+Usage: python examples/wordfreq2.py file1 [file2 ...]
+"""
+
+import sys
+
+from gpu_mapreduce_tpu.apps.wordfreq import _fileread, _sum
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+
+LIMIT = 10
+
+
+def _print_top(mr, label):
+    print(label)
+    shown = [0]
+
+    def output(key, value, ptr):
+        if shown[0] < LIMIT:
+            shown[0] += 1
+            word = key.decode(errors="replace") if isinstance(key, bytes) \
+                else key
+            print(f"  {int(value)} {word}")
+
+    mr.scan_kv(output)
+
+
+def main(files):
+    mr = MapReduce()
+    nwords = mr.map_files(files, _fileread)
+    nfiles = len(files)
+    mr.collate()
+    nunique = mr.reduce(_sum)
+
+    # pass 1: per-proc top-N on the locally sorted KV (flag=0 pass,
+    # wordfreq2.cpp:79-90 — on one controller "local" is the whole
+    # dataset, but the two-pass structure is the point of the example)
+    mr.sort_values(-1)
+    _print_top(mr, f"top {LIMIT} (local sort):")
+
+    # pass 2: the global answer — gather to 1 proc, re-sort, print
+    mr.gather(1)
+    mr.sort_values(-1)
+    _print_top(mr, f"top {LIMIT} (global, after gather):")
+
+    print(f"{nwords} total words, {nunique} unique words "
+          f"({nfiles} files)")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit(f"usage: {sys.argv[0]} file1 [file2 ...]")
+    main(sys.argv[1:])

@@ -304,21 +304,10 @@ def _balance_files(files: Sequence[str], P: int):
     """Split the file list into P CONTIGUOUS chunks of ~equal bytes (the
     reference's consecutive per-proc file ranges,
     cuda/InvertedIndex.cu:284-287).  Returns [(first_index, files,
-    sizes)]*P — sizes ride along so the batching step doesn't re-stat
-    every file."""
-    sizes = np.array([os.path.getsize(f) for f in files], np.int64)
-    total = max(int(sizes.sum()), 1)
-    mid = np.cumsum(sizes) - sizes // 2
-    assign = np.minimum((mid * P) // total, P - 1)  # non-decreasing
-    shards = []
-    i = 0
-    for p in range(P):
-        j = i
-        while j < len(files) and assign[j] == p:
-            j += 1
-        shards.append((i, list(files[i:j]), sizes[i:j]))
-        i = j
-    return shards
+    sizes)]*P — the shared policy of parallel/ingest.balance_by_bytes
+    (one implementation, so the two ingest paths cannot diverge)."""
+    from ..parallel.ingest import balance_by_bytes
+    return balance_by_bytes(files, P)
 
 
 def _bucket_words(nwords: int) -> int:

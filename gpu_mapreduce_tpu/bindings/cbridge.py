@@ -50,6 +50,10 @@ SCAN_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
 MAPCHUNK_FN = ctypes.CFUNCTYPE(None, ctypes.c_int,
                                ctypes.POINTER(ctypes.c_char), ctypes.c_int,
                                ctypes.c_void_p, ctypes.c_void_p)
+MAPMR_FN = ctypes.CFUNCTYPE(None, ctypes.c_uint64,
+                            ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                            ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+                            ctypes.c_void_p, ctypes.c_void_p)
 HASH_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int)
 CMP_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.POINTER(ctypes.c_char),
                           ctypes.c_int, ctypes.POINTER(ctypes.c_char),
@@ -191,6 +195,38 @@ def mr_map_file_chunks(h: int, which: str, nmap: int, paths: List[bytes],
     if which == "char":
         return mr.map_file_char(nmap, files, 0, 0, sep, delta, wrapper)
     return mr.map_file_str(nmap, files, 0, 0, sep, delta, wrapper)
+
+
+def mr_map_mr(h: int, h2: int, fnptr: int, appptr: int) -> int:
+    """MR_map_mr: per-pair map over an existing MR's KV (reference
+    map(mr,func,...) via C, src/cmapreduce.cpp; self-map h2 == h works
+    through map_mr's snapshot).  The callback sees the raw key/value
+    bytes exactly as the reference's byte-packed pages would.
+
+    Unlike the task-scoped wrappers, this one registers the target kv
+    ONCE and lets KeyValue.add's own 1M-row scalar buffer do the
+    batching — a per-pair _KVAccum would build one single-row frame per
+    pair (r5 review)."""
+    fn = MAPMR_FN(fnptr)
+    mr, src = _get(h), _get(h2)
+    reg: dict = {}
+
+    def wrapper(itask, k, v, kv, ptr):
+        kvh = reg.get(id(kv))
+        if kvh is None:
+            kvh = _register(kv)
+            reg[id(kv)] = kvh
+        kb, vb = _to_bytes(k), _to_bytes(v)
+        fn(itask,
+           ctypes.create_string_buffer(kb, len(kb)), len(kb),
+           ctypes.create_string_buffer(vb, len(vb)), len(vb),
+           kvh, appptr)
+
+    try:
+        return mr.map_mr(src, wrapper)
+    finally:
+        for kvh in reg.values():
+            _handles.pop(kvh, None)
 
 
 def mr_aggregate_hash(h: int, fnptr: int) -> int:

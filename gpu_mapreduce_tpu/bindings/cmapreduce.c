@@ -209,6 +209,15 @@ uint64_t MR_map_file_str(void *mr, int nmap, int nstr, char **paths,
                     (int)strlen(sepstr), delta, fn, ptr);
 }
 
+uint64_t MR_map_mr(void *mr, void *mr2,
+                   void (*fn)(uint64_t, char *, int, char *, int, void *,
+                              void *),
+                   void *ptr) {
+  return as_u64(bridge_call("mr_map_mr", "(nnnn)", (Py_ssize_t)mr,
+                            (Py_ssize_t)mr2, (Py_ssize_t)(intptr_t)fn,
+                            (Py_ssize_t)(intptr_t)ptr));
+}
+
 uint64_t MR_aggregate_hash(void *mr, int (*myhash)(char *, int)) {
   return as_u64(bridge_call("mr_aggregate_hash", "(nn)", (Py_ssize_t)mr,
                             (Py_ssize_t)(intptr_t)myhash));

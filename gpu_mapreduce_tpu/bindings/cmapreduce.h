@@ -67,6 +67,14 @@ uint64_t MR_map_file_str(void *mr, int nmap, int nstr, char **paths,
                          void (*mymap)(int itask, char *bytes, int nbytes,
                                        void *kv, void *ptr),
                          void *ptr);
+/* map over an existing MR's KV pairs, incl. self-map mr2 == mr
+ * (reference MR_map_mr, src/cmapreduce.cpp): mymap(itask, key,
+ * keybytes, value, valuebytes, KVptr, APPptr) */
+uint64_t MR_map_mr(void *mr, void *mr2,
+                   void (*mymap)(uint64_t itask, char *key, int keybytes,
+                                 char *value, int valuebytes,
+                                 void *kv, void *ptr),
+                   void *ptr);
 
 /* shuffle / grouping / reduce */
 uint64_t MR_aggregate(void *mr);

@@ -153,6 +153,15 @@ class BytesColumn(Column):
         ids, table = _intern_ids(strings, strings, "bytes")
         return DenseColumn(ids), table
 
+    def intern_sharded(self, tables: "ShardTables") -> "DenseColumn":
+        """Intern into dest-sharded decode tables — no controller-global
+        dict ever builds (VERDICT r4 #5); cross-batch collisions surface
+        in ShardTables.absorb."""
+        strings = [bytes(s) for s in self.data]
+        ids, uniq, first = _intern_core(strings)
+        tables.absorb(uniq, [strings[int(i)] for i in first])
+        return DenseColumn(ids)
+
     def __repr__(self):
         return f"BytesColumn<n={len(self)}>"
 
@@ -166,9 +175,20 @@ def _intern_ids(strings, rows, kind: str):
     the device tier's standard, apps/invertedindex).  The byte buffer
     packs ONCE for both families.  Returns (ids uint64[n], InternTable);
     the former per-row Python dict loop was the aggregate hot spot."""
+    ids, uniq, first = _intern_core(strings)
+    table = InternTable(((int(h), rows[int(i)]) for h, i in
+                         zip(uniq, first)), kind=kind)
+    return ids, table
+
+
+def _intern_core(strings):
+    """Hash + collision-check core shared by the global and the
+    dest-sharded intern: returns (ids uint64[n], unique ids uint64[u],
+    first-occurrence row index int64[u])."""
     from .. import native
     if not len(strings):
-        return np.zeros(0, np.uint64), InternTable(kind=kind)
+        z = np.zeros(0, np.uint64)
+        return z, z, np.zeros(0, np.int64)
     if native.available():
         lens = np.fromiter((len(s) for s in strings), np.int64,
                            count=len(strings))
@@ -199,9 +219,19 @@ def _intern_ids(strings, rows, kind: str):
             raise ValueError(
                 "64-bit intern collision between %r and %r"
                 % (strings[order[i]], strings[order[i + 1]]))
-    table = InternTable(((int(h), rows[int(i)]) for h, i in
-                         zip(si[head], order[head])), kind=kind)
-    return ids, table
+    return ids, si[head], order[head]
+
+
+def dest_of_ids(ids: np.ndarray, P: int) -> np.ndarray:
+    """Aggregate destination shard of each u64 id — the HOST twin of the
+    device shuffle's ``default_hash(keys) % P`` (lookup3 over the key's
+    little-endian bytes, parallel/shuffle.py).  hash_words32 runs the
+    same word-path lookup3 on numpy input, so the routing is bit-
+    identical to what the exchange will do on device."""
+    from ..ops.hash import hash_words32
+    words = np.ascontiguousarray(ids.astype("<u8")).view("<u4")
+    return (hash_words32(words.reshape(len(ids), 2)).astype(np.int64)
+            % P).astype(np.int32)
 
 
 class InternTable(dict):
@@ -212,6 +242,154 @@ class InternTable(dict):
     def __init__(self, *a, kind: str = "bytes", **kw):
         super().__init__(*a, **kw)
         self.kind = kind
+
+    def decode_batch(self, ids) -> list:
+        return [self[int(h)] for h in ids]
+
+
+class ShardTables:
+    """Dest-sharded id→row decode tables (VERDICT r4 #5).
+
+    The reference shuffles raw key bytes fully distributed
+    (``src/mapreduce.cpp:453-473``); our exchange moves u64 ids and keeps
+    the bytes host-side.  Instead of ONE controller-global dict, every
+    (id, bytes) entry lives in the table of the shard the DEFAULT hash
+    routes that id to (``dest_of_ids`` — the same lookup3 % P the device
+    exchange applies).  Lookups always re-route by the same id hash, so
+    decode is correct on every path.  The LOCALITY guarantee — shard d's
+    rows decode from ``tables[d]`` alone after an exchange — holds for
+    KEY tables under the default aggregate hash (the per-shard output
+    case, and the entries a multi-host mesh would keep host-local).  A
+    custom hash_fn or the value-side tables still get the size bound
+    (~1/P of the id space per table) but place rows independently of
+    their table, so cross-table decode_batch routing is the contract
+    there, not per-table locality.
+
+    Quacks like the InternTable dict for every existing consumer
+    (``__getitem__``/``get``/``decode_batch``/``kind``)."""
+
+    # _rank_cache: sort_interned_sharded memoises its id→rank permutation
+    # on the table object (same contract as InternTable's dynamic attr)
+    __slots__ = ("tables", "P", "kind", "_probes", "_rank_cache")
+
+    def __init__(self, P: int, kind: str = "bytes"):
+        self.P = P
+        self.kind = kind
+        self.tables = [InternTable(kind=kind) for _ in range(P)]
+        # per-DEST id→pickle side tables for object rows — sharded like
+        # the row tables, so no flat controller-global dict rebuilds
+        # what the class exists to avoid (r5 review)
+        self._probes: Optional[list] = None
+        self._rank_cache = None
+
+    def merge(self, other) -> "ShardTables":
+        """Union with another decode table (ShardTables or plain dict) —
+        the concat_sharded / MapReduce.add path.  Everything funnels
+        through absorb so overlapping ids get the same cross-batch
+        collision check as ingest (and object rows compare by pickle,
+        never by __eq__ — r5 review)."""
+        kind = ("object" if "object" in (self.kind,
+                                         getattr(other, "kind", "bytes"))
+                else "bytes")
+        out = ShardTables(self.P, kind=kind)
+        for src in (self, other):
+            ids = np.fromiter(src.keys(), np.uint64, len(src))
+            rows = (src.decode_batch(ids) if hasattr(src, "decode_batch")
+                    else [src[int(h)] for h in ids])
+            # reuse stored probes (the bytes that were HASHED) instead
+            # of re-pickling live rows — cheaper, and immune to objects
+            # mutated after ingest (r5 review)
+            probes = (src.probes_for(ids)
+                      if isinstance(src, ShardTables) else None)
+            out.absorb(ids, rows, probes=probes)
+        return out
+
+    def probes_for(self, ids: np.ndarray):
+        """Stored pickle probes for these ids, or None when this table
+        never needed probes (bytes rows compare directly)."""
+        if self._probes is None:
+            return None
+        dests = dest_of_ids(np.asarray(ids, np.uint64), self.P)
+        return [self._probes[d][int(h)]
+                for h, d in zip(ids.tolist(), dests.tolist())]
+
+    def absorb(self, uniq_ids: np.ndarray, rows: list,
+               probes: Optional[list] = None) -> None:
+        """Route unique (id, row) pairs into the per-dest tables; a
+        pre-existing id with DIFFERENT bytes is a real u64 intern
+        collision (cross-batch — within-batch collisions are caught by
+        the intern core's alt-family check).  ``probes``: comparison
+        bytes when rows are arbitrary objects (object __eq__ is not a
+        reliable identity; the pickle is — it IS what was hashed)."""
+        if not len(uniq_ids):
+            return
+        if self.kind == "object" and probes is None:
+            # object rows always compare by pickle — normalise here so
+            # a probe-less batch (e.g. bytes rows promoted into an
+            # object-kind table) can never compare a pickle to a row
+            import pickle
+            probes = [pickle.dumps(r, protocol=4) for r in rows]
+        if probes is not None and self._probes is None:
+            self._probes = [{} for _ in range(self.P)]
+        dests = dest_of_ids(np.asarray(uniq_ids, np.uint64), self.P)
+        for i, (h, d) in enumerate(zip(uniq_ids.tolist(), dests.tolist())):
+            t = self.tables[d]
+            if h not in t:
+                t[h] = rows[i]
+                if probes is not None:
+                    self._probes[d][h] = probes[i]
+                continue
+            prev = self._probes[d][h] if probes is not None else t[h]
+            cur = probes[i] if probes is not None else rows[i]
+            if prev != cur:
+                raise ValueError(
+                    f"64-bit intern collision: {prev!r} vs {cur!r}")
+
+    def shard(self, d: int) -> InternTable:
+        return self.tables[d]
+
+    def __getitem__(self, h):
+        return self.tables[int(dest_of_ids(np.array([h], np.uint64),
+                                           self.P)[0])][h]
+
+    def get(self, h, default=None):
+        try:
+            return self[h]
+        except KeyError:
+            return default
+
+    def __contains__(self, h) -> bool:
+        # not via get(): an ObjectColumn row may legitimately BE None
+        try:
+            self[h]
+            return True
+        except KeyError:
+            return False
+
+    def __len__(self) -> int:
+        return sum(len(t) for t in self.tables)
+
+    def decode_batch(self, ids) -> list:
+        """Vectorised decode: one dest computation for the whole id
+        array, then per-shard dict lookups (the scalar __getitem__ would
+        pay a hash dispatch per row)."""
+        ids = np.asarray(ids, np.uint64)
+        dests = dest_of_ids(ids, self.P)
+        tabs = self.tables
+        return [tabs[d][int(h)] for h, d in zip(ids.tolist(),
+                                                dests.tolist())]
+
+    def items(self):
+        for t in self.tables:
+            yield from t.items()
+
+    def keys(self):
+        for t in self.tables:
+            yield from t.keys()
+
+    def __repr__(self):
+        sizes = [len(t) for t in self.tables]
+        return f"ShardTables(P={self.P}, kind={self.kind}, sizes={sizes})"
 
 
 class ObjectColumn(Column):
@@ -267,6 +445,16 @@ class ObjectColumn(Column):
         ids, table = _intern_ids(self.pickles(), self.data.tolist(),
                                  "object")
         return DenseColumn(ids), table
+
+    def intern_sharded(self, tables: "ShardTables") -> "DenseColumn":
+        """See BytesColumn.intern_sharded; rows are the live objects,
+        compared across batches by their pickles."""
+        rows = self.data.tolist()
+        pk = self.pickles()
+        ids, uniq, first = _intern_core(pk)
+        tables.absorb(uniq, [rows[int(i)] for i in first],
+                      probes=[pk[int(i)] for i in first])
+        return DenseColumn(ids)
 
     def __repr__(self):
         return f"ObjectColumn<n={len(self)}>"

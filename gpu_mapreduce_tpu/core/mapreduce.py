@@ -294,18 +294,41 @@ class MapReduce:
                   readflag: int = 0, addflag: int = 0) -> int:
         """File map: func(itask, filename, kv, ptr) per file (reference
         map(nstr,strings,self,recurse,readflag,func,ptr,addflag),
-        src/mapreduce.cpp:1060-1092)."""
+        src/mapreduce.cpp:1060-1092).
+
+        On a mesh backend the ingest is PER-SHARD (parallel/ingest.py):
+        each shard's contiguous byte-balanced slice of the file list
+        lands on its own device at map time, with byte/object keys
+        interned into dest-sharded decode tables — the reference's
+        'every rank reads its own files' map stage
+        (src/mapreduce.cpp:1102-1225).  ``last_ingest`` records which
+        path ran."""
         t = self._begin_op()
         if isinstance(files, str):
             files = [files]
         names = findfiles(files, bool(recurse), bool(readflag))
         kv = self._start_map(addflag)
-        self._run_tasks(kv, names,
-                        lambda itask, fname, sink: func(itask, fname, sink,
-                                                        ptr))
+        call = lambda itask, fname, sink: func(itask, fname, sink, ptr)
+        if self._mesh_ingest_ok(addflag):
+            from ..parallel.ingest import mesh_map_files
+            self.last_ingest = mesh_map_files(self, kv, names, call)
+        else:
+            self._run_tasks(kv, names, call)
+            self.last_ingest = {"mode": "host"}
         n = self._finish_kv("map_files")
         self._time("map_files", t)
         return n
+
+    def _mesh_ingest_ok(self, addflag: int) -> bool:
+        """Per-shard file ingest preconditions: a multi-shard mesh, a
+        fresh KV (addflag appends into an existing — possibly host —
+        dataset), and in-core (the out-of-core page/spill budget is the
+        host frames' machinery)."""
+        from ..parallel.backend import MeshBackend
+        return (isinstance(self.backend, MeshBackend)
+                and self.backend.nprocs > 1
+                and not addflag
+                and self.settings.outofcore != 1)
 
     def map_file_char(self, nmap: int, files, recurse: int, readflag: int,
                       sepchar: Union[str, bytes], delta: int, func: Callable,
@@ -334,13 +357,18 @@ class MapReduce:
             self.error.all("No files found for chunked map")
         per_file = max(1, nmap // max(1, len(names)))
         kv = self._start_map(addflag)
-        chunks = (chunk for fname in names
-                  for chunk in file_chunks(fname, per_file, sep, delta))
-        # the serial chunk reader feeds the window lazily — under
-        # mapstyle 2 backpressure holds O(window) chunks, not all
-        self._run_tasks(kv, chunks,
-                        lambda itask, chunk, sink: func(itask, chunk, sink,
-                                                        ptr))
+        call = lambda itask, chunk, sink: func(itask, chunk, sink, ptr)
+        if self._mesh_ingest_ok(addflag):
+            from ..parallel.ingest import mesh_map_chunks
+            self.last_ingest = mesh_map_chunks(self, kv, names, per_file,
+                                               sep, delta, call)
+        else:
+            chunks = (chunk for fname in names
+                      for chunk in file_chunks(fname, per_file, sep, delta))
+            # the serial chunk reader feeds the window lazily — under
+            # mapstyle 2 backpressure holds O(window) chunks, not all
+            self._run_tasks(kv, chunks, call)
+            self.last_ingest = {"mode": "host"}
         n = self._finish_kv("map_chunks")
         self._time("map_chunks", t)
         return n

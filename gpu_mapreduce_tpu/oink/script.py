@@ -214,6 +214,7 @@ class OinkScript:
                              if self.screen is not None else False)
         cmd.params(params)
         i = 0
+        ninput_args = 0
         while i < len(rest):
             if rest[i] == "-i":
                 j = i + 1
@@ -221,6 +222,7 @@ class OinkScript:
                     j += 1
                 for a in rest[i + 1:j]:
                     self._add_input(a)
+                ninput_args += j - i - 1
                 i = j
             elif rest[i] == "-o":
                 j = i + 1
@@ -239,6 +241,15 @@ class OinkScript:
                 i = j
             else:
                 raise MRError("Invalid command switch")
+        # one arg per input descriptor, arity checked like the reference
+        # (command.cpp:21-27 "Mismatch in command inputs") — silently
+        # dropping extras hid a two-file `-i f1 f2` on a 1-input command
+        # (r5 verify); a multi-file input goes through a v_name variable
+        if ninput_args and ninput_args != cmd.ninputs:
+            raise MRError(
+                f"Mismatch in command inputs: {name} takes "
+                f"{cmd.ninputs}, got {ninput_args} (use a v_name "
+                f"variable for a multi-file input)")
         t0 = _time.perf_counter()
         try:
             cmd.run()

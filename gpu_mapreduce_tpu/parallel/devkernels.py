@@ -104,7 +104,7 @@ def skv_map(skv: ShardedKV, fn, static=(), extra=(),
                             row_sharding(skv.mesh))
     k, v, c = _skv_map_jit(skv.mesh, fn, tuple(static), len(extra))(
         skv.key, skv.value, counts, *extra)
-    SyncStats.pulls += 1
+    SyncStats.bump()
     return ShardedKV(skv.mesh, k, v, np.asarray(c).astype(np.int32),
                      key_decode=kd, value_decode=vd)
 
@@ -136,7 +136,7 @@ def skmv_map(kmv: ShardedKMV, fn, static=(), extra=(),
     k, v, c = _skmv_map_jit(kmv.mesh, fn, tuple(static), len(extra))(
         kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values,
         put(kmv.gcounts), put(kmv.vcounts), *extra)
-    SyncStats.pulls += 1
+    SyncStats.bump()
     return ShardedKV(kmv.mesh, k, v, np.asarray(c).astype(np.int32),
                      key_decode=kd, value_decode=vd)
 
@@ -173,7 +173,11 @@ def _merge_decode(ta, tb, what: str):
             f"to a plain one: the merge would span two {what} spaces")
     if not tb:
         return ta
-    from ..core.column import InternTable
+    from ..core.column import InternTable, ShardTables
+    if isinstance(ta, ShardTables):
+        return ta.merge(tb)
+    if isinstance(tb, ShardTables):
+        return tb.merge(ta)
     kind = ("object" if "object" in (getattr(ta, "kind", "bytes"),
                                      getattr(tb, "kind", "bytes"))
             else "bytes")
@@ -188,7 +192,7 @@ def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
                                    row_sharding(a.mesh))
     k, v, c = _concat_jit(a.mesh)(a.key, a.value, put(a), b.key, b.value,
                                   put(b))
-    SyncStats.pulls += 1
+    SyncStats.bump()
     return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32),
                      key_decode=_merge_decode(a.key_decode, b.key_decode,
                                               "key"),

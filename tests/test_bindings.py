@@ -98,3 +98,37 @@ def test_c_abi_tail(cblocked):
                       "k0": "8", "k1": "8", "k2": "8"}
     assert sorted(counts) == list(counts)          # sort_keys(5) order
     assert any("Cummulative" in ln for ln in lines)
+
+
+@pytest.fixture(scope="module")
+def crmat(tmp_path_factory):
+    out = tmp_path_factory.mktemp("bin") / "crmat"
+    return build_example("crmat", out=str(out))
+
+
+def test_crmat_generates_unique_matrix(crmat, tmp_path):
+    """The reference's examples/crmat.c flow through the C ABI: the
+    generate-until-unique loop, the degree histogram finishing with a
+    descending MR_sort_keys, and the MR_map_mr stats pass (added r5)."""
+    out = tmp_path / "mat"
+    r = _run(crmat, "6", "4", "0.25", "0.25", "0.25", "0.25", "0.0",
+             "7", str(out), cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = r.stdout.splitlines()
+    assert lines[0] == "64 rows in matrix"
+    assert lines[1] == "256 nonzeroes in matrix"
+    # edge file: exactly ntotal unique "vi vj" lines within range
+    edges = (tmp_path / "mat.0").read_text().splitlines()
+    assert len(edges) == 256 and len(set(edges)) == 256
+    for ln in edges[:16]:
+        vi, vj = map(int, ln.split())
+        assert 0 <= vi < 64 and 0 <= vj < 64
+    # histogram body: descending degrees, counts sum to rows with >=1
+    # nonzero; final summary line consistent
+    hist = [tuple(map(int, ln.split())) for ln in lines[2:-2]]
+    degs = [d for d, _ in hist]
+    assert degs == sorted(degs, reverse=True) and all(d > 0 for d in degs)
+    nrows = sum(c for _, c in hist)
+    zero_line = lines[-2]
+    assert zero_line == f"{64 - nrows} rows with 0 nonzeroes"
+    assert sum(d * c for d, c in hist) == 256
