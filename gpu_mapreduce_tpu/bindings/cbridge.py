@@ -63,10 +63,22 @@ SCANKMV_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
                               ctypes.POINTER(ctypes.c_int), ctypes.c_void_p)
 
 
+_reg_lock = None  # created lazily to keep module import light
+
+
 def _register(obj) -> int:
-    h = _next_id[0]
-    _next_id[0] += 1
-    _handles[h] = obj
+    # locked: mapstyle-2 worker threads register per-task accumulators
+    # concurrently, and `_next_id[0] += 1` is a read-modify-write — two
+    # tasks sharing one handle would cross-route their kv_adds (r5
+    # review)
+    global _reg_lock
+    if _reg_lock is None:
+        import threading
+        _reg_lock = threading.Lock()
+    with _reg_lock:
+        h = _next_id[0]
+        _next_id[0] += 1
+        _handles[h] = obj
     return h
 
 

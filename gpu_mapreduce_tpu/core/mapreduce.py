@@ -109,6 +109,9 @@ class MapReduce:
         self.kmv: Optional[KeyMultiValue] = None
         self._open = False
         self._last_stats: dict = {}
+        # which path the last file map took ({"mode": "mesh"|"host", …},
+        # parallel/ingest.py); None-mode until a file map runs
+        self.last_ingest: dict = {"mode": None}
 
     # ------------------------------------------------------------------
     # settings passthrough (reference exposes them as public members)

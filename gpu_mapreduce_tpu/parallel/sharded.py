@@ -83,11 +83,13 @@ class ToHostStats:
 
     @classmethod
     def snapshot(cls):
-        return (cls.kv, cls.kmv)
+        with _STATS_LOCK:          # one consistent (kv, kmv) pair —
+            return (cls.kv, cls.kmv)  # never a torn mix (r5 review)
 
     @classmethod
     def delta(cls, snap):
-        return (cls.kv - snap[0], cls.kmv - snap[1])
+        with _STATS_LOCK:
+            return (cls.kv - snap[0], cls.kmv - snap[1])
 
 
 def _decode_col(table: dict, ids: np.ndarray):

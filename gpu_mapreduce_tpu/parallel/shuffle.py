@@ -320,7 +320,21 @@ def _plan_caps(counts_mat: np.ndarray):
     return B, nrounds, cap_out, Bmax, new_counts
 
 
-class ExchangeStats:
+class _ExchangeStatsMeta(type):
+    """Class-level assignment to the legacy names would silently
+    REPLACE their read-through descriptors and freeze the value (the
+    pre-r5 reset idiom `ExchangeStats.last_nrounds = 0` did exactly
+    this) — intercept it with a clear error (r5 review)."""
+
+    def __setattr__(cls, name, value):
+        if name in ("last_nrounds", "last_bucket"):
+            raise AttributeError(
+                f"{name} is a read-only view of ExchangeStats.last — "
+                f"assign ExchangeStats.last = (nrounds, bucket) instead")
+        super().__setattr__(name, value)
+
+
+class ExchangeStats(metaclass=_ExchangeStatsMeta):
     """Telemetry of the LAST exchange's flow control (class attrs, like
     sharded.ToHostStats): the multi-round path is invisible from the
     outside — results are identical either way — so the driver dryrun

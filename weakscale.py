@@ -114,13 +114,15 @@ def main():
             t = Timer()
             n = mr.reduce(count, batch=True)
             stages["reduce"] = t.elapsed()
-            return n, stages
+            # r5 evidence: the generic map path ingests per shard now
+            return n, stages, mr.last_ingest["mode"]
 
         from gpu_mapreduce_tpu.core.runtime import global_counters
         for P in sizes:
             run(P)                       # pay the per-mesh XLA compiles
-            n, stages = run(P, global_counters())   # steady state
+            n, stages, ingest = run(P, global_counters())  # steady state
             rows.append({"nprocs": P, "nunique": int(n),
+                         "ingest": ingest,
                          **{k: round(v, 3) for k, v in stages.items()}})
             print(json.dumps(rows[-1]))
     record = {"weak_scaling": rows, "mb_per_proc": mb_per_proc,
