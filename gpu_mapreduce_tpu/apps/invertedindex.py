@@ -46,7 +46,8 @@ from jax import lax
 from ..core.mapreduce import MapReduce
 from .. import native
 from ..ops.hash import hash_bytes64_masked
-from ..ops.pallas.match import (MARK_PAGE_WORDS, bytes_view_u32,
+from ..ops.pallas.match import (DEFAULT_COMPACT, MARK_PAGE_WORDS,
+                                bytes_view_u32,
                                 compact_word_matches, first_byte_pos,
                                 mark_words_pallas, mark_words_xla,
                                 mask_words_to_length, unaligned_words)
@@ -77,12 +78,12 @@ def _env_knobs():
     lru_cache/jit cache, so toggling one of these within a process takes
     effect on the next run() instead of silently reusing the old trace:
 
-    MR_COMPACT       'scatter' (default) | 'searchsorted' | 'blocked'
+    MR_COMPACT       'blocked' (default) | 'scatter' | 'searchsorted'
     MR_WINDOW_BS     rows per lax.map window step, floored to a power of
                      two (caps are powers of two, so the reshape divides)
     MR_MARK_PAGE_WORDS  Pallas mark page size (ops/pallas/match.py)
     """
-    compact = os.environ.get("MR_COMPACT", "scatter")
+    compact = os.environ.get("MR_COMPACT", DEFAULT_COMPACT)
     bs_raw = int(os.environ.get("MR_WINDOW_BS", _BS))
     page_words = int(os.environ.get("MR_MARK_PAGE_WORDS",
                                     MARK_PAGE_WORDS))
@@ -146,7 +147,8 @@ def _extract_wide_fn(cap: int, use_pallas: bool, interpret: bool):
 
 
 def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
-                  interpret: bool, wide: bool, compact: str = "scatter",
+                  interpret: bool, wide: bool,
+                  compact: str = DEFAULT_COMPACT,
                   bs: int = _BS, page_words: int = MARK_PAGE_WORDS):
     """The fused map-stage computation over ONE shard's corpus words.
     Shared by the single-device jit (_extract_build) and the mesh SPMD
@@ -236,7 +238,7 @@ def _extract_core(words, file_starts, *, cap: int, use_pallas: bool,
 
 @functools.lru_cache(maxsize=None)
 def _extract_build(cap: int, use_pallas: bool, interpret: bool,
-                   wide: bool = False, compact: str = "scatter",
+                   wide: bool = False, compact: str = DEFAULT_COMPACT,
                    bs: int = _BS, page_words: int = MARK_PAGE_WORDS):
     return jax.jit(functools.partial(
         _extract_core, cap=cap, use_pallas=use_pallas,

@@ -90,7 +90,8 @@ def main() -> int:
     # compact: ALL THREE bit-identical variants timed in isolation — even
     # a window that dies before the full-matrix A/B answers the round-5
     # question "which compaction lowering holds the extract tail".
-    # "compact" keeps its historical meaning (the shipped scatter default).
+    # "compact" keeps its historical meaning (the r4 scatter default;
+    # the r5 shipped default is 'blocked', timed below).
     wmask = mark(words)
     comp = jax.jit(functools.partial(mt.compact_word_matches,
                                      nbytes=nbytes, max_hits=cap,
@@ -147,9 +148,10 @@ def main() -> int:
         timed(jax.jit(_pack), ids, alts, lens, starts), 4)
     flush()
 
-    # full fused dispatch — the engine's map_device program at the
-    # SHIPPED default knobs (explicit: immune to the watcher's A/B-best
-    # env exports on a retried run)
+    # full fused dispatch — the engine's map_device program at FIXED
+    # historical knobs (scatter/4096/4M — comparable to the r4 0.98 s
+    # row, and immune to the watcher's A/B-best env exports on a
+    # retried run); the headline bench measures the shipped defaults
     fn = ii._extract_build(cap, True, interp, False, "scatter", ii._BS,
                            mt.MARK_PAGE_WORDS)
     rec["sections"]["full"] = round(timed(fn, words, fst), 4)
