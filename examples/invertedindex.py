@@ -32,7 +32,11 @@ def main(argv):
 
     comm = None
     if args.mesh:
+        import jax
         from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+        ndev = len(jax.devices())
+        if args.mesh > ndev:
+            sys.exit(f"--mesh {args.mesh}: only {ndev} devices available")
         comm = make_mesh(args.mesh)
     idx = InvertedIndex(engine=args.engine, comm=comm)
     npairs, nunique = idx.run(args.paths, outdir=args.outdir)

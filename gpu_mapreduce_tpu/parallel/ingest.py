@@ -197,9 +197,14 @@ def _put_blocks(blocks: List[np.ndarray], cap: int, mesh):
 
 
 def build_sharded(frames: List[KVFrame], mesh):
-    """Per-shard host frames → one ShardedKV (rows stay on the shard
-    that read them), interning byte/object columns into dest-sharded
-    tables.  Raises Unshardable when the frames cannot agree."""
+    """Per-shard host frames → one ShardedKV, interning byte/object
+    columns into dest-sharded tables.  Rows normally stay on the shard
+    whose file slice produced them — EXCEPT a severely lopsided ingest
+    (max shard > 2× the even share, e.g. one file on an 8-shard mesh),
+    which re-splits rows evenly: the padded cap tracks the fullest
+    shard, so keeping the skew would move ~P× the real rows through
+    every downstream collective.  Raises Unshardable when the frames
+    cannot agree."""
     from .sharded import ShardedKV, round_cap, _pad_rows
     P = len(frames)
     kcols, ktables = _intern_side([f.key for f in frames], P)
@@ -209,6 +214,25 @@ def build_sharded(frames: List[KVFrame], mesh):
     kdt, kshape = _common_spec(karrs)
     vdt, vshape = _common_spec(varrs)
     counts = np.array([a.shape[0] for a in karrs], np.int32)
+    total = int(counts.sum())
+    if P > 1 and total and int(counts.max()) > 2 * (-(-total // P)):
+        # lopsided ingest (fewer files than shards — e.g. one edge file
+        # on an 8-shard mesh): the padded cap tracks the FULLEST shard,
+        # so every downstream collective would move ~P x the real rows.
+        # Re-split evenly — free on a single controller (the bytes are
+        # already in host RAM), and order-preserving.  A multi-host
+        # runtime would keep locality instead; with one file only one
+        # host has the data anyway (r5 P=8 soak regression).
+        kall = np.concatenate([a.astype(kdt, copy=False)
+                               .reshape((-1,) + kshape) for a in karrs])
+        vall = np.concatenate([a.astype(vdt, copy=False)
+                               .reshape((-1,) + vshape) for a in varrs])
+        per = -(-total // P)
+        starts = np.minimum(np.arange(P) * per, total)
+        ends = np.minimum(starts + per, total)
+        karrs = [kall[s:e] for s, e in zip(starts, ends)]
+        varrs = [vall[s:e] for s, e in zip(starts, ends)]
+        counts = (ends - starts).astype(np.int32)
     cap = round_cap(int(counts.max()) if counts.max() else 0)
     kb = [_pad_rows(a.astype(kdt, copy=False).reshape((-1,) + kshape), cap)
           for a in karrs]

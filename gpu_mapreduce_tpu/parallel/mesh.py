@@ -81,3 +81,40 @@ def flat_axis_index(mesh: Mesh):
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   local_device_ids: Optional[Sequence[int]] = None) -> int:
+    """Join JAX's multi-controller runtime so ``make_mesh()`` spans every
+    host's chips — the reference's ``MPI_Init`` for multi-node runs (its
+    NCCL/MPI backend scales the same way; SURVEY.md §5 "distributed
+    communication backend").  Call once per process BEFORE any other jax
+    use; args default to the cluster auto-detection
+    (``jax.distributed.initialize``'s env/cloud discovery).  Returns
+    this process's index.
+
+    What is and isn't multi-host ready: the SPMD compute paths — the
+    exchange collectives, the fused graph engines, per-shard output —
+    address only LOCAL shards (``addressable_shards`` /
+    ``addressable_devices_indices_map`` everywhere), so each process
+    computes and writes its own hosts' slices, with DCN routes via
+    ``make_mesh2``.  Dest-sharded decode tables (``ShardTables``) mean
+    a process only needs the tables of shards it writes.  Host-side
+    INGESTION is per-shard in *placement* but not yet in *reads*: the
+    generic ``map_files`` runs every callback in the calling process —
+    a multi-controller deployment should hand each process its own
+    file slice.  ``to_host`` of the whole dataset and host per-pair
+    callbacks stay single-controller conveniences."""
+    kw = {}
+    if coordinator is not None:
+        kw["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kw["num_processes"] = num_processes
+    if process_id is not None:
+        kw["process_id"] = process_id
+    if local_device_ids is not None:
+        kw["local_device_ids"] = list(local_device_ids)
+    jax.distributed.initialize(**kw)
+    return jax.process_index()

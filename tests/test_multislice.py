@@ -143,3 +143,38 @@ def test_per_shard_output_on_multislice_mesh(tmp_path):
             assert url.encode() not in got
             got[url.encode()] = set(names.split(" "))
     assert got == dict(oracle)
+
+
+def test_init_multihost_single_process():
+    """init_multihost (the MPI_Init analog) joins the multi-controller
+    runtime; exercised at num_processes=1 in a subprocess (the runtime
+    binds ports and can only initialise once per process)."""
+    import os
+    import subprocess
+    import sys
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=4'\n"
+        "from gpu_mapreduce_tpu.utils.platform import pin_platform\n"
+        "pin_platform('cpu')\n"
+        "from gpu_mapreduce_tpu.parallel.mesh import (init_multihost,"
+        " make_mesh, mesh_axis_size)\n"
+        "import socket\n"
+        "s = socket.socket(); s.bind(('127.0.0.1', 0))\n"
+        "port = s.getsockname()[1]; s.close()\n"
+        "pid = init_multihost(f'127.0.0.1:{port}', 1, 0)\n"
+        "assert pid == 0, pid\n"
+        "import jax\n"
+        "assert jax.process_count() == 1\n"
+        "assert mesh_axis_size(make_mesh()) == 4\n"
+        "print('OK')\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "OK" in r.stdout
