@@ -1,0 +1,103 @@
+"""The example drivers run end-to-end as subprocesses — the reference
+ships its examples as its acceptance surface (examples/README), so ours
+must keep working, not just the library underneath them."""
+
+import collections
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420, env_extra=None):
+    env = dict(os.environ, PYTHONPATH=REPO, **(env_extra or {}))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.fixture(scope="module")
+def word_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("words")
+    random.seed(3)
+    vocab = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    oracle = collections.Counter()
+    files = []
+    for i in range(3):
+        ws = random.choices(vocab, [9, 7, 5, 3, 1], k=1200)
+        oracle.update(ws)
+        p = d / f"w{i}.txt"
+        p.write_text(" ".join(ws))
+        files.append(str(p))
+    return files, oracle
+
+
+def test_wordfreq_driver(word_files):
+    files, oracle = word_files
+    r = _run("wordfreq.py", *files)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert f"{sum(oracle.values())} total words, " \
+           f"{len(oracle)} unique words" in r.stdout
+
+
+def test_wordfreq2_driver_two_passes(word_files):
+    files, oracle = word_files
+    r = _run("wordfreq2.py", *files)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = r.stdout
+    assert "top 10 (local sort):" in out
+    assert "top 10 (global, after gather):" in out
+    top_word, top_count = oracle.most_common(1)[0]
+    # both passes lead with the global max (one controller: local=global)
+    assert out.count(f"{top_count} {top_word}") == 2
+    assert f"{sum(oracle.values())} total words" in out
+
+
+def test_invertedindex_driver_mesh(tmp_path):
+    files = []
+    for i in range(4):
+        p = tmp_path / f"d{i}.html"
+        p.write_bytes((b'<a href="http://e.org/p%d">x</a> pad ' % (i % 3))
+                      * 5)
+        files.append(str(p))
+    out = tmp_path / "out"
+    env_extra = {"JAX_PLATFORMS": "cpu",
+                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+    r = _run("invertedindex.py", str(out), *files,
+             "--engine", "xla", "--mesh", "8", env_extra=env_extra)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "20 (url, doc) pairs, 3 unique urls" in r.stdout
+    parts = sorted(os.listdir(out))
+    assert parts == [f"part-{i:05d}" for i in range(8)]
+    lines = [ln for p in parts
+             for ln in (out / p).read_text().splitlines()]
+    assert len(lines) == 3
+    # --mesh beyond the device count must refuse, not truncate — pin
+    # the actual refusal message, not just any failing run
+    r2 = _run("invertedindex.py", str(out), *files, "--mesh", "99",
+              timeout=240, env_extra=env_extra)
+    assert r2.returncode != 0
+    assert "devices available" in (r2.stderr + r2.stdout)
+
+
+def test_rmat_driver(tmp_path):
+    r = _run("rmat.py", "8", "4", "0.25", "0.25", "0.25", "0.25",
+             "0.0", "7", str(tmp_path / "mat"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    edges = (tmp_path / "mat").read_text().splitlines()
+    assert len(edges) == 256 * 4 and len(set(edges)) == len(edges)
+
+
+def test_intcount_driver(tmp_path):
+    rng = np.random.default_rng(6)
+    vals = rng.integers(0, 50, 4096).astype("<u4")
+    p = tmp_path / "ints.bin"
+    p.write_bytes(vals.tobytes())
+    r = _run("intcount.py", str(p))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert f"{len(np.unique(vals))} unique" in r.stdout
