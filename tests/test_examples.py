@@ -38,11 +38,13 @@ def word_files(tmp_path_factory):
 
 
 def test_wordfreq_driver(word_files):
+    import re
     files, oracle = word_files
     r = _run("wordfreq.py", *files)
     assert r.returncode == 0, r.stderr[-2000:]
-    assert f"{sum(oracle.values())} total words, " \
-           f"{len(oracle)} unique words" in r.stdout
+    # \b anchors: a digit-prefixed wrong value must not suffix-match
+    assert re.search(rf"\b{sum(oracle.values())} total words, "
+                     rf"{len(oracle)} unique words", r.stdout)
 
 
 def test_wordfreq2_driver_two_passes(word_files):
@@ -52,10 +54,13 @@ def test_wordfreq2_driver_two_passes(word_files):
     out = r.stdout
     assert "top 10 (local sort):" in out
     assert "top 10 (global, after gather):" in out
+    import re
     top_word, top_count = oracle.most_common(1)[0]
-    # both passes lead with the global max (one controller: local=global)
-    assert out.count(f"{top_count} {top_word}") == 2
-    assert f"{sum(oracle.values())} total words" in out
+    # both passes lead with the global max (one controller: local=global);
+    # line-anchored so a digit-prefixed wrong count can't match
+    assert len(re.findall(rf"^  {top_count} {top_word}$", out,
+                          re.M)) == 2
+    assert re.search(rf"\b{sum(oracle.values())} total words", out)
 
 
 def test_invertedindex_driver_mesh(tmp_path):
@@ -94,10 +99,12 @@ def test_rmat_driver(tmp_path):
 
 
 def test_intcount_driver(tmp_path):
+    import re
     rng = np.random.default_rng(6)
     vals = rng.integers(0, 50, 4096).astype("<u4")
     p = tmp_path / "ints.bin"
     p.write_bytes(vals.tobytes())
     r = _run("intcount.py", str(p))
     assert r.returncode == 0, r.stderr[-2000:]
-    assert f"{len(np.unique(vals))} unique" in r.stdout
+    assert re.search(rf"\b{len(np.unique(vals))} unique", r.stdout)
+    assert re.search(rf"\b{len(vals)} ", r.stdout), r.stdout  # total too
