@@ -72,7 +72,8 @@ def main() -> int:
 
     paths, nurls, _ = bench.corpus_cached(mb, False, False)
     corpus, fstarts = ii._build_corpus(paths)
-    words = jnp.asarray(mt.bytes_view_u32(corpus))
+    # bounded H2D: a 256 MB single device_put dies on the tunnel (r4)
+    words = bench.h2d_chunked(mt.bytes_view_u32(corpus))
     fst = jnp.asarray(fstarts)
     nbytes = int(corpus.shape[0])
     del corpus
