@@ -123,27 +123,14 @@ def enable_compilation_cache():
 
 
 def h2d_chunked(host_arr, chunk_bytes: int = 32 << 20):
-    """Bounded-message host→device transfer: the axon tunnel fails (or
-    silently hangs) on large single messages — the r4 lesson that gave
-    the bench path _h2d_sharded.  The diagnostic scripts (tpu_ab,
-    tpu_profile_map) move the same 256 MB corpus and must use the same
-    discipline (r5).  Honors the same MR_H2D_CHUNK_WORDS override as
-    the engine's _h2d_sharded (words = u32 lanes, ×4 bytes), and sizes
-    chunks by ROW bytes so multi-dim inputs stay bounded too."""
+    """Bounded-message host→device transfer for the bench/diagnostic
+    scripts — delegates to the ONE shared implementation
+    (parallel.mesh.device_put_chunked, incl. the MR_H2D_CHUNK_WORDS
+    override), then blocks so timed regions start with the data
+    resident."""
     import jax
-    import jax.numpy as jnp
-    env = os.environ.get("MR_H2D_CHUNK_WORDS")
-    if env is not None:
-        if int(env) <= 0:
-            raise ValueError(f"MR_H2D_CHUNK_WORDS={env}: must be > 0")
-        chunk_bytes = int(env) * 4
-    rowbytes = max(1, int(host_arr.nbytes // max(1, host_arr.shape[0])))
-    step = max(1, chunk_bytes // rowbytes)
-    if host_arr.shape[0] <= step:
-        return jax.device_put(host_arr)
-    parts = [jax.device_put(host_arr[o:o + step])
-             for o in range(0, host_arr.shape[0], step)]
-    out = jnp.concatenate(parts)
+    from gpu_mapreduce_tpu.parallel.mesh import device_put_chunked
+    out = device_put_chunked(host_arr, chunk_bytes=chunk_bytes)
     jax.block_until_ready(out)
     return out
 

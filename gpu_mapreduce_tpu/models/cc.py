@@ -109,7 +109,8 @@ def cc_sharded(mesh: Mesh, src: np.ndarray, dst: np.ndarray, n: int,
         src.astype(np.int32), dst.astype(np.int32), nprocs)
     shard = NamedSharding(mesh, row_spec(mesh))
     run = _cc_sharded_fn(mesh, n, maxiter or max(n, 1))
-    lab, iters = run(jax.device_put(src_p, shard),
-                     jax.device_put(dst_p, shard),
-                     jax.device_put(valid_p, shard))
+    from ..parallel.mesh import device_put_chunked
+    lab, iters = run(device_put_chunked(src_p, shard),
+                     device_put_chunked(dst_p, shard),
+                     device_put_chunked(valid_p, shard))
     return np.asarray(lab), int(iters)

@@ -132,8 +132,10 @@ def luby_mis_sharded(mesh: Mesh, src: np.ndarray, dst: np.ndarray,
         src.astype(np.int32), dst.astype(np.int32), nprocs)
     shard = NamedSharding(mesh, row_spec(mesh))
     run = _luby_sharded_fn(mesh, n, maxiter or max(n, 1))
-    state, iters = run(jax.device_put(src_p, shard),
-                       jax.device_put(dst_p, shard),
-                       jax.device_put(valid_p, shard),
-                       jnp.asarray(prio))
+    from ..parallel.mesh import device_put_chunked, replicated
+    state, iters = run(device_put_chunked(src_p, shard),
+                       device_put_chunked(dst_p, shard),
+                       device_put_chunked(valid_p, shard),
+                       device_put_chunked(np.asarray(prio),
+                                          replicated(mesh)))
     return np.asarray(state), int(iters)

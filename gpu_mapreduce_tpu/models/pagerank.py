@@ -177,9 +177,12 @@ def pagerank_sharded(mesh: Mesh, src: np.ndarray, dst: np.ndarray, n: int,
     nprocs = mesh_axis_size(mesh)
     src_p, dst_p, valid_p = pad_edges_for_mesh(src, dst, nprocs)
     edge_shard = NamedSharding(mesh, row_spec(mesh))
-    src_d = jax.device_put(src_p, edge_shard)
-    dst_d = jax.device_put(dst_p, edge_shard)
-    valid_d = jax.device_put(valid_p, edge_shard)
+    # bounded per-device messages: a scale-22 edge column is ~134 MB,
+    # past what a tunneled single device_put survives (r5)
+    from ..parallel.mesh import device_put_chunked
+    src_d = device_put_chunked(src_p, edge_shard)
+    dst_d = device_put_chunked(dst_p, edge_shard)
+    valid_d = device_put_chunked(valid_p, edge_shard)
     run = _sharded_run_fn(mesh, n, tol, maxiter, damping)
     ranks, iters = run(src_d, dst_d, valid_d)
     return np.asarray(ranks), int(iters)

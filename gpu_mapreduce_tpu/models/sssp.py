@@ -118,8 +118,11 @@ def prepare_bellman_ford(mesh: Mesh, src: np.ndarray, dst: np.ndarray,
                           np.zeros(len(src_p) - len(w))])
     shard = NamedSharding(mesh, row_spec(mesh))
     fn = _bf_sharded_fn(mesh, n, maxiter or max(n, 1))
-    args = (jax.device_put(src_p, shard), jax.device_put(dst_p, shard),
-            jax.device_put(w_p, shard), jax.device_put(valid_p, shard))
+    from ..parallel.mesh import device_put_chunked
+    args = (device_put_chunked(src_p, shard),
+            device_put_chunked(dst_p, shard),
+            device_put_chunked(w_p, shard),
+            device_put_chunked(valid_p, shard))
 
     def run(source: int):
         dist, pred, iters = fn(*args, jnp.int32(source))

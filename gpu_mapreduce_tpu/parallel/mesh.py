@@ -83,6 +83,52 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def device_put_chunked(host, sharding: Optional[NamedSharding] = None,
+                       chunk_bytes: int = 32 << 20):
+    """``jax.device_put`` in bounded per-device messages.
+
+    Tunneled TPU setups fail (or silently hang) on large single
+    transfer messages — the r4 bench lesson, applied here to EVERY
+    bulk H2D: each device's block travels as ≤``chunk_bytes`` pieces
+    concatenated on its own device.  Honors the same
+    ``MR_H2D_CHUNK_WORDS`` override as the ingest paths (u32 words,
+    ×4 bytes).  With ``sharding=None`` the array lands on the default
+    device."""
+    import os
+    env = os.environ.get("MR_H2D_CHUNK_WORDS")
+    if env is not None:
+        if int(env) <= 0:
+            raise ValueError(f"MR_H2D_CHUNK_WORDS={env}: must be > 0")
+        chunk_bytes = int(env) * 4
+    host = np.asarray(host)
+    if host.ndim == 0 or host.nbytes <= chunk_bytes:
+        return jax.device_put(host, sharding) if sharding is not None \
+            else jax.device_put(host)
+    import jax.numpy as jnp
+
+    def put_block(block, dev):
+        # dev=None → uncommitted puts on the configured default device
+        # (committing to devices()[0] would flip placement semantics on
+        # a size threshold the caller never sees — r5 review)
+        put = (jax.device_put if dev is None
+               else lambda x: jax.device_put(x, dev))
+        rowbytes = max(1, int(block.nbytes // max(1, block.shape[0])))
+        step = max(1, chunk_bytes // rowbytes)
+        if block.shape[0] <= step:
+            return put(block)
+        parts = [put(block[o:o + step])
+                 for o in range(0, block.shape[0], step)]
+        return jnp.concatenate(parts)
+
+    if sharding is None:
+        return put_block(host, None)
+    dmap = sharding.addressable_devices_indices_map(host.shape)
+    shards = [put_block(np.ascontiguousarray(host[idx]), dev)
+              for dev, idx in dmap.items()]
+    return jax.make_array_from_single_device_arrays(
+        host.shape, sharding, shards)
+
+
 def init_multihost(coordinator: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None,
