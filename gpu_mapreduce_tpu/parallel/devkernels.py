@@ -207,9 +207,10 @@ def clone_sharded(skv: ShardedKV) -> ShardedKMV:
     nv = (np.arange(cap)[None, :] < skv.counts[:, None]).astype(np.int32)
     vo = np.tile(np.arange(cap, dtype=np.int32), (P, 1))
     sharding = row_sharding(skv.mesh)
+    from .mesh import device_put_chunked
     return ShardedKMV(skv.mesh, skv.key,
-                      jax.device_put(nv.reshape(-1), sharding),
-                      jax.device_put(vo.reshape(-1), sharding),
+                      device_put_chunked(nv.reshape(-1), sharding),
+                      device_put_chunked(vo.reshape(-1), sharding),
                       skv.value, skv.counts.copy(), skv.counts.copy(),
                       key_decode=skv.key_decode,
                       value_decode=skv.value_decode)

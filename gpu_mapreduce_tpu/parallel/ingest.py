@@ -174,18 +174,21 @@ def _common_spec(arrs: List[np.ndarray]):
 
 def _put_blocks(blocks: List[np.ndarray], cap: int, mesh):
     """Device-put per-shard row blocks [cap,...] each onto ITS device in
-    bounded messages; assemble the row-sharded global [P*cap,...]."""
-    from .mesh import row_sharding
+    bounded messages (mesh.h2d_chunk_bytes — honors MR_H2D_CHUNK_WORDS
+    like every other chunked-transfer site); assemble the row-sharded
+    global [P*cap,...]."""
+    from .mesh import h2d_chunk_bytes, row_sharding
     P = len(blocks)
     sharding = row_sharding(mesh)
     shape = (P * cap,) + blocks[0].shape[1:]
     dmap = sharding.addressable_devices_indices_map(shape)
+    budget = h2d_chunk_bytes(H2D_CHUNK_BYTES)
     shards = []
     for dev, idx in dmap.items():
         p = (idx[0].start or 0) // cap
         host = np.ascontiguousarray(blocks[p])
         rowbytes = max(1, int(host.nbytes // max(1, cap)))
-        chunk = max(1, H2D_CHUNK_BYTES // rowbytes)
+        chunk = max(1, budget // rowbytes)
         if cap > chunk:
             import jax.numpy as jnp
             parts = [jax.device_put(host[o:o + chunk], dev)

@@ -83,6 +83,19 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def h2d_chunk_bytes(default: int = 32 << 20) -> int:
+    """The per-message H2D budget, with the MR_H2D_CHUNK_WORDS override
+    (u32 words, ×4 bytes) — ONE parse shared by every chunked-transfer
+    site so the knob cannot be honored in some paths and not others."""
+    import os
+    env = os.environ.get("MR_H2D_CHUNK_WORDS")
+    if env is None:
+        return default
+    if int(env) <= 0:
+        raise ValueError(f"MR_H2D_CHUNK_WORDS={env}: must be > 0")
+    return int(env) * 4
+
+
 def device_put_chunked(host, sharding: Optional[NamedSharding] = None,
                        chunk_bytes: int = 32 << 20):
     """``jax.device_put`` in bounded per-device messages.
@@ -94,12 +107,7 @@ def device_put_chunked(host, sharding: Optional[NamedSharding] = None,
     ``MR_H2D_CHUNK_WORDS`` override as the ingest paths (u32 words,
     ×4 bytes).  With ``sharding=None`` the array lands on the default
     device."""
-    import os
-    env = os.environ.get("MR_H2D_CHUNK_WORDS")
-    if env is not None:
-        if int(env) <= 0:
-            raise ValueError(f"MR_H2D_CHUNK_WORDS={env}: must be > 0")
-        chunk_bytes = int(env) * 4
+    chunk_bytes = h2d_chunk_bytes(chunk_bytes)
     host = np.asarray(host)
     if host.ndim == 0 or host.nbytes <= chunk_bytes:
         return jax.device_put(host, sharding) if sharding is not None \

@@ -357,6 +357,9 @@ def shard_frame_with_counts(frame: KVFrame, mesh: Mesh,
         kb.append(_pad_rows(k[offs[i]:offs[i + 1]], cap))
         vb.append(_pad_rows(v[offs[i]:offs[i + 1]], cap))
     sharding = row_sharding(mesh)
-    key = jax.device_put(np.concatenate(kb), sharding)
-    value = jax.device_put(np.concatenate(vb), sharding)
+    # bounded per-device messages: at soak scale a shard block is
+    # >100 MB, past what a tunneled single transfer survives (r5)
+    from .mesh import device_put_chunked
+    key = device_put_chunked(np.concatenate(kb), sharding)
+    value = device_put_chunked(np.concatenate(vb), sharding)
     return ShardedKV(mesh, key, value, counts.astype(np.int32))
