@@ -299,6 +299,12 @@ def run_bench(engine, backend_err):
     jax.config.update("jax_enable_x64", True)  # u64 url ids on device
     enable_compilation_cache()
     from gpu_mapreduce_tpu.apps.invertedindex import InvertedIndex
+    from gpu_mapreduce_tpu.obs import aggregate_ops, get_tracer
+
+    # subscribe to the span stream instead of hand-rolling timers: the
+    # detail record's per-op rows come from the same tracer every layer
+    # reports into (MRTPU_TRACE additionally streams the JSONL file)
+    tracer = get_tracer().enable()
 
     comm = None
     if engine in ("pallas", "xla"):
@@ -316,6 +322,7 @@ def run_bench(engine, backend_err):
     warm.run(paths)
 
     idx = InvertedIndex(engine=engine, comm=comm)
+    tracer.clear()             # timed run only: drop the warmup spans
     t0 = time.perf_counter()
     npairs, nunique = idx.run(paths)
     dt = time.perf_counter() - t0
@@ -355,6 +362,9 @@ def run_bench(engine, backend_err):
         # device-tier batching + two-tier window machinery (VERDICT r2
         # #9: the recorded detail must show these exercised at volume)
         "map_stats": getattr(idx, "stats", {}),
+        # per-span-name rows of the timed run (count/total_s/byte sums)
+        # from the obs/ tracer — the machine-readable twin of stages_sec
+        "trace_ops": aggregate_ops(tracer.events()),
     }
     try:
         print(json.dumps({"detail": detail}), file=sys.stderr)

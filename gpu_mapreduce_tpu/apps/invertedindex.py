@@ -446,22 +446,29 @@ class StageTimer:
     @contextlib.contextmanager
     def stage(self, name: str):
         g = self._groups.get(name)
-        t0 = time.perf_counter()
-        if g is not None:
-            with self._lock:
-                depth, ts = self._gactive.get(g, (0, 0.0))
-                self._gactive[g] = (depth + 1, t0 if depth == 0 else ts)
-        try:
-            yield
-        finally:
-            t1 = time.perf_counter()
-            with self._lock:
-                self.times[name] = self.times.get(name, 0.0) + t1 - t0
-                if g is not None:
-                    depth, ts = self._gactive[g]
-                    if depth == 1:
-                        self._gwall[g] = self._gwall.get(g, 0.0) + t1 - ts
-                    self._gactive[g] = (depth - 1, ts)
+        # each stage is also a tracer span (obs/), so an app run under
+        # MRTPU_TRACE shows its pipeline stages next to the MR-op spans;
+        # the with-statement keeps exception attribution and the
+        # thread-local span stack correct when a stage raises
+        from ..obs import get_tracer
+        with get_tracer().span("stage." + name, cat="app"):
+            t0 = time.perf_counter()
+            if g is not None:
+                with self._lock:
+                    depth, ts = self._gactive.get(g, (0, 0.0))
+                    self._gactive[g] = (depth + 1, t0 if depth == 0 else ts)
+            try:
+                yield
+            finally:
+                t1 = time.perf_counter()
+                with self._lock:
+                    self.times[name] = self.times.get(name, 0.0) + t1 - t0
+                    if g is not None:
+                        depth, ts = self._gactive[g]
+                        if depth == 1:
+                            self._gwall[g] = self._gwall.get(g, 0.0) \
+                                + t1 - ts
+                        self._gactive[g] = (depth - 1, ts)
 
     def wall(self, group: str) -> float:
         """Accumulated span-union seconds of the named group."""

@@ -63,7 +63,13 @@ SCANKMV_FN = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_int,
                               ctypes.POINTER(ctypes.c_int), ctypes.c_void_p)
 
 
-_reg_lock = None  # created lazily to keep module import light
+# created at import: the lazy create was itself racy — two mapstyle-2
+# workers making the FIRST concurrent _register could each see None and
+# build different Lock objects, un-serializing the very RMW the lock
+# guards (ADVICE r5)
+import threading as _threading
+
+_reg_lock = _threading.Lock()
 
 
 def _register(obj) -> int:
@@ -71,10 +77,6 @@ def _register(obj) -> int:
     # concurrently, and `_next_id[0] += 1` is a read-modify-write — two
     # tasks sharing one handle would cross-route their kv_adds (r5
     # review)
-    global _reg_lock
-    if _reg_lock is None:
-        import threading
-        _reg_lock = threading.Lock()
     with _reg_lock:
         h = _next_id[0]
         _next_id[0] += 1

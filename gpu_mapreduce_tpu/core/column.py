@@ -287,7 +287,15 @@ class ShardTables:
         the concat_sharded / MapReduce.add path.  Everything funnels
         through absorb so overlapping ids get the same cross-batch
         collision check as ingest (and object rows compare by pickle,
-        never by __eq__ — r5 review)."""
+        never by __eq__ — r5 review).
+
+        CONTRACT: both tables' ids must live in ONE hash domain.  A
+        bytes-kind table hashes raw bytes, an object-kind table hashes
+        pickles — merging across kinds would give the same logical key
+        two distinct ids (they'd never group).  concat_sharded aligns
+        domains first (devkernels._align_domains re-interns the
+        bytes-kind side through the pickle domain, ADVICE r5); direct
+        callers mixing kinds must do the same."""
         kind = ("object" if "object" in (self.kind,
                                          getattr(other, "kind", "bytes"))
                 else "bytes")

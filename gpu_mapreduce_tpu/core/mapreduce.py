@@ -23,6 +23,7 @@ MPI_Allreduce'd returns (``src/mapreduce.cpp:557-558``).
 from __future__ import annotations
 
 import copy as _copymod
+import functools
 import sys
 import time
 from typing import Callable, List, Optional, Sequence, Union
@@ -90,15 +91,48 @@ class _TaskSink:
         self._calls.clear()
 
 
+def _traced(fn):
+    """Wrap an MR op in a tracer span (gpu_mapreduce_tpu/obs): wall
+    time, counter deltas (shuffle/pad/spill bytes, HBM hi-water) and the
+    returned global pair count land as span attributes; nesting follows
+    the call structure (collate parents aggregate+convert, compress
+    parents convert+reduce, the shuffle/ingest child spans hang under
+    their op).  Disabled tracing costs one attribute check."""
+    op = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kw):
+        tr = self.tracer
+        if not tr.enabled:
+            return fn(self, *args, **kw)
+        with tr.span(op, cat="mr_op",
+                     shards=self.backend.nprocs) as sp:
+            out = fn(self, *args, **kw)
+            if isinstance(out, int):
+                sp.set(npairs=out)
+            if op.startswith("map_file"):
+                sp.set(ingest=self.last_ingest.get("mode"))
+            return out
+    return wrapper
+
+
 class MapReduce:
     """One MapReduce object owns at most one KV and/or one KMV
     (reference src/mapreduce.h:43-44)."""
 
-    def __init__(self, comm=None, **settings):
+    def __init__(self, comm=None, trace=None, **settings):
         self.error = Error()
         self.settings = Settings(**settings)
         self.settings.validate(self.error)
         self.counters = global_counters()
+        # tracing is process-global (obs/): `trace=path` turns on the
+        # JSONL sink (the MRTPU_TRACE env var does the same without a
+        # code change); `trace=True` enables the in-memory ring only
+        from ..obs import get_tracer
+        self.tracer = get_tracer()
+        if trace:
+            self.tracer.enable(jsonl=trace if isinstance(trace, str)
+                               else None)
         if comm is None or comm == 1 or (isinstance(comm, int)):
             self.backend = SerialBackend()
         else:
@@ -190,7 +224,11 @@ class MapReduce:
     def _begin_op(self) -> Timer:
         """Per-op start: timer + counter snapshot for verbosity=2 deltas
         (the reference's file_stats/stats per-op reporting,
-        src/mapreduce.cpp:3112-3226)."""
+        src/mapreduce.cpp:3112-3226).  The obs/ span layer snapshots the
+        same counters independently (Span.__enter__) — kept separate on
+        purpose: the print path must work with tracing disabled, and the
+        disabled tracer must cost nothing, so neither can own the other's
+        snapshot."""
         c = self.counters
         self._op_snap = (c.wsize, c.rsize, c.cssize)
         return Timer()
@@ -213,10 +251,13 @@ class MapReduce:
 
     def _tier_note(self, op: str, fr) -> None:
         """verbosity≥2: say which tier an op ran on — a silent fall to the
-        host per-pair path is a 1000× slowdown the user should see."""
+        host per-pair path is a 1000× slowdown the user should see.  The
+        same fact lands on the current span (obs/) for machine readers."""
+        from .frame import KMVFrame, KVFrame as _KVF
+        host = isinstance(fr, (KMVFrame, _KVF))
+        self.tracer.annotate(tier="host" if host else "device",
+                             rows=len(fr))
         if self.settings.verbosity >= 2:
-            from .frame import KMVFrame, KVFrame as _KVF
-            host = isinstance(fr, (KMVFrame, _KVF))
             n = len(fr)
             print(f"  {op}: {'host per-row' if host else 'device batch'} "
                   f"tier ({n} rows)")
@@ -280,6 +321,7 @@ class MapReduce:
                 raise
         return n
 
+    @_traced
     def map(self, nmap: int, func: Callable, ptr=None, addflag: int = 0) -> int:
         """Task map: func(itask, kv, ptr) called for nmap tasks
         (reference map(nmap,func,ptr,addflag) → map_tasks,
@@ -292,6 +334,7 @@ class MapReduce:
         self._time("map", t)
         return n
 
+    @_traced
     def map_files(self, files: Union[str, Sequence[str]], func: Callable,
                   ptr=None, self_flag: int = 0, recurse: int = 0,
                   readflag: int = 0, addflag: int = 0) -> int:
@@ -333,6 +376,7 @@ class MapReduce:
                 and not addflag
                 and self.settings.outofcore != 1)
 
+    @_traced
     def map_file_char(self, nmap: int, files, recurse: int, readflag: int,
                       sepchar: Union[str, bytes], delta: int, func: Callable,
                       ptr=None, addflag: int = 0) -> int:
@@ -342,6 +386,7 @@ class MapReduce:
         return self._map_chunks(nmap, files, recurse, readflag,
                                 _to_bytes(sepchar), delta, func, ptr, addflag)
 
+    @_traced
     def map_file_str(self, nmap: int, files, recurse: int, readflag: int,
                      sepstr: Union[str, bytes], delta: int, func: Callable,
                      ptr=None, addflag: int = 0) -> int:
@@ -376,6 +421,7 @@ class MapReduce:
         self._time("map_chunks", t)
         return n
 
+    @_traced
     def map_mr(self, mr: "MapReduce", func: Callable, ptr=None,
                addflag: int = 0, batch: bool = False) -> int:
         """Map over an existing MR's KV pairs (reference map(mr,func,...),
@@ -403,6 +449,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # shuffle / distribution ops
     # ------------------------------------------------------------------
+    @_traced
     def aggregate(self, hash_fn: Optional[Callable] = None) -> int:
         """THE shuffle: each key to one proc — user hash or
         hashlittle(key)%nprocs (reference src/mapreduce.cpp:385-563;
@@ -414,6 +461,7 @@ class MapReduce:
         self._time("aggregate", t, comm=True)
         return int(self.backend.allreduce_sum(kv.nkv))
 
+    @_traced
     def broadcast(self, root: int = 0) -> int:
         """Replicate root's KV on all procs (reference
         src/mapreduce.cpp:569-623)."""
@@ -421,6 +469,7 @@ class MapReduce:
         self.backend.broadcast(self, root)
         return int(self.backend.allreduce_sum(kv.nkv))
 
+    @_traced
     def gather(self, nprocs: int) -> int:
         """Funnel KV onto the first nprocs procs (reference
         src/mapreduce.cpp:893-1036)."""
@@ -430,6 +479,7 @@ class MapReduce:
         self.backend.gather(self, nprocs)
         return int(self.backend.allreduce_sum(kv.nkv))
 
+    @_traced
     def scrunch(self, nprocs: int, key) -> int:
         """gather + collapse (reference src/mapreduce.cpp:2075-2095)."""
         self.gather(nprocs)
@@ -496,6 +546,7 @@ class MapReduce:
         newkv.complete_done = True
         self.kv = newkv
 
+    @_traced
     def convert(self) -> int:
         """Local KV→KMV grouping (reference src/mapreduce.cpp:861-886 →
         KeyMultiValue::convert; here sort+segment, SURVEY.md §3.3).  An
@@ -531,11 +582,13 @@ class MapReduce:
         self._time("convert", t)
         return int(self.backend.allreduce_sum(n))
 
+    @_traced
     def collate(self, hash_fn: Optional[Callable] = None) -> int:
         """aggregate + convert (reference src/mapreduce.cpp:710-738)."""
         self.aggregate(hash_fn)
         return self.convert()
 
+    @_traced
     def clone(self) -> int:
         """KV→KMV, each pair its own 1-value group (reference
         src/mapreduce.cpp:631-652).  Sharded input clones per shard on
@@ -555,6 +608,7 @@ class MapReduce:
         self.kmv.push(kmv_frame)
         return int(self.backend.allreduce_sum(self.kmv.complete()))
 
+    @_traced
     def collapse(self, key) -> int:
         """KV→single KMV group per proc: multivalue = [k1,v1,k2,v2,...]
         (reference src/mapreduce.cpp:681-702).  Keys and values must share a
@@ -581,6 +635,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # reduce family
     # ------------------------------------------------------------------
+    @_traced
     def reduce(self, func: Callable, ptr=None, batch: bool = False,
                block_rows: Optional[int] = None) -> int:
         """Callback per KMV group → new KV (reference
@@ -606,6 +661,7 @@ class MapReduce:
             elif block_rows is not None:
                 self._reduce_blocked(fr, func, kv, ptr, block_rows)
             else:
+                self.tracer.annotate(tier="host", groups=len(fr))
                 if self.settings.verbosity >= 2:
                     print(f"  reduce: host per-group tier ({len(fr)} groups)")
                 for k, vals in fr.groups():
@@ -626,6 +682,7 @@ class MapReduce:
             else:
                 func(k, fr.group_values(i).tolist(), kv, ptr)
 
+    @_traced
     def compress(self, func: Callable, ptr=None, batch: bool = False,
                  block_rows: Optional[int] = None) -> int:
         """Local convert + reduce, KV→KV — the combiner (reference
@@ -636,6 +693,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # scan / print (read-only)
     # ------------------------------------------------------------------
+    @_traced
     def scan_kv(self, func: Callable, ptr=None, batch: bool = False) -> int:
         """Read-only iteration over KV pairs (reference
         src/mapreduce.cpp:1933-1997)."""
@@ -648,6 +706,7 @@ class MapReduce:
                     func(k, v, ptr)
         return int(self.backend.allreduce_sum(kv.nkv))
 
+    @_traced
     def scan_kmv(self, func: Callable, ptr=None, batch: bool = False,
                  block_rows: Optional[int] = None) -> int:
         """Read-only iteration over KMV groups (reference
@@ -696,6 +755,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # sorting (reference src/mapreduce.cpp:2102-2352)
     # ------------------------------------------------------------------
+    @_traced
     def sort_keys(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Per-proc sort of KV by key.  int flag: |flag| selects the
         reference's pre-built comparator family (moot for typed columns),
@@ -704,6 +764,7 @@ class MapReduce:
         (appcompare)."""
         return self._sort_kv(by="key", flag_or_cmp=flag_or_cmp)
 
+    @_traced
     def sort_values(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Per-proc sort of KV by value (reference src/mapreduce.cpp:2152)."""
         return self._sort_kv(by="value", flag_or_cmp=flag_or_cmp)
@@ -790,6 +851,7 @@ class MapReduce:
         self._time("sort", t)
         return int(self.backend.allreduce_sum(newkv.nkv))
 
+    @_traced
     def sort_multivalues(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Sort values *within* each multivalue (reference
         src/mapreduce.cpp:2210-2352)."""
@@ -818,6 +880,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # whole-object ops
     # ------------------------------------------------------------------
+    @_traced
     def add(self, mr: "MapReduce") -> int:
         """Append mr's KV pairs to my KV (reference
         src/mapreduce.cpp:348-374)."""
@@ -898,31 +961,46 @@ class MapReduce:
     # checkpoint / restore (capability improvement over the reference,
     # which persists only via print-to-file text — SURVEY.md §5)
     # ------------------------------------------------------------------
+    @_traced
     def save(self, path: str) -> int:
         """Checkpoint the current KV or KMV to a directory; returns the
         number of frames written (core/checkpoint.py)."""
         from .checkpoint import save as _save
         return _save(self, path)
 
+    @_traced
     def load(self, path: str) -> int:
         """Replace the dataset with a checkpoint; returns the global
         pair/group count."""
         from .checkpoint import load as _load
         return _load(self, path)
 
+    def stats(self) -> dict:
+        """The structured cumulative snapshot that ``cummulative_stats``
+        prints: every Counters field by name (msizemax, rsize, wsize,
+        cssize, crsize, cspad, commtime, msize), plus — when tracing is
+        enabled (obs/) — an ``"ops"`` per-op aggregate over the span
+        ring (count / total_s / byte sums per op name)."""
+        out = self.counters.snapshot()
+        if self.tracer.enabled:
+            out["ops"] = self.tracer.stats()
+        return out
+
     def cummulative_stats(self, level: int = 1, reset: int = 0):
-        c = self.counters
+        # a formatting consumer of the same snapshot stats() returns —
+        # the two can never disagree
+        s = self.stats()
         if level:
-            print(f"Cummulative hi-water mem = {c.msizemax / (1 << 20):.3g} Mb")
-            print(f"Cummulative spill I/O = {c.rsize / (1 << 20):.3g} Mb read, "
-                  f"{c.wsize / (1 << 20):.3g} Mb written")
-            print(f"Cummulative comm = {c.cssize / (1 << 20):.3g} Mb sent, "
-                  f"{c.crsize / (1 << 20):.3g} Mb received, "
-                  f"{c.cspad / (1 << 20):.3g} Mb padding, "
-                  f"{c.commtime:.3g} secs")
+            print(f"Cummulative hi-water mem = {s['msizemax'] / (1 << 20):.3g} Mb")
+            print(f"Cummulative spill I/O = {s['rsize'] / (1 << 20):.3g} Mb read, "
+                  f"{s['wsize'] / (1 << 20):.3g} Mb written")
+            print(f"Cummulative comm = {s['cssize'] / (1 << 20):.3g} Mb sent, "
+                  f"{s['crsize'] / (1 << 20):.3g} Mb received, "
+                  f"{s['cspad'] / (1 << 20):.3g} Mb padding, "
+                  f"{s['commtime']:.3g} secs")
         if reset:
-            c.__init__()
-        return c
+            self.counters.__init__()
+        return self.counters
 
     def _time(self, op: str, t: Timer, comm: bool = False):
         dt = t.elapsed()

@@ -114,6 +114,15 @@ class Counters:
             if self.msize > self.msizemax:
                 self.msizemax = self.msize
 
+    def snapshot(self) -> dict:
+        """Consistent copy of every counter field — the structured twin
+        of the ``cummulative_stats`` print (MapReduce.stats)."""
+        with self._lock:
+            return {"msize": self.msize, "msizemax": self.msizemax,
+                    "rsize": self.rsize, "wsize": self.wsize,
+                    "cssize": self.cssize, "crsize": self.crsize,
+                    "cspad": self.cspad, "commtime": self.commtime}
+
 
 class Timer:
     __slots__ = ("t0",)

@@ -1,0 +1,31 @@
+"""Observability: structured tracing + metrics for every layer.
+
+The reference exposes runtime behavior only as printf-style reports
+(``kv_stats``/``cummulative_stats``, ``src/mapreduce.cpp:2937-3066``).
+This package is the machine-readable twin: a thread-safe tracer with
+nested spans that every layer reports into (MR ops in
+``core/mapreduce.py``, collectives in ``parallel/shuffle.py``, H2D
+staging in ``parallel/ingest.py``, script commands in
+``oink/script.py``), pluggable sinks (in-memory ring, JSONL file,
+callbacks), a Chrome trace-event (Perfetto-loadable) exporter, and a
+per-op summarizer.
+
+Enable via ``MRTPU_TRACE=/path/trace.jsonl``, ``MapReduce(trace=...)``,
+or ``get_tracer().enable()``.  When disabled, ``tracer.span()`` returns
+a shared no-op singleton — zero allocation, zero per-op cost.
+
+See ``doc/observability.md`` for the span model and Perfetto how-to.
+"""
+
+from .tracer import (NULL_SPAN, Span, Tracer, configure_from_env,
+                     get_tracer)
+from .sinks import (CallbackSink, JsonlSink, RingSink, chrome_trace,
+                    read_jsonl, write_chrome_trace)
+from .report import aggregate_ops, per_op_table
+
+__all__ = [
+    "Tracer", "Span", "NULL_SPAN", "get_tracer", "configure_from_env",
+    "RingSink", "JsonlSink", "CallbackSink",
+    "chrome_trace", "write_chrome_trace", "read_jsonl",
+    "aggregate_ops", "per_op_table",
+]

@@ -1,0 +1,128 @@
+"""Span-event sinks + Chrome trace-event export.
+
+Events arrive already in Chrome trace-event form (tracer.Span.event):
+complete events (``ph: "X"``) with ``ts``/``dur`` in microseconds.  The
+JSONL file is therefore self-describing — one event per line — and
+:func:`chrome_trace` only wraps the list so Perfetto / chrome://tracing
+load it directly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Callable, List
+
+
+def _jsonable(x):
+    """json.dumps default= hook: numpy scalars/arrays, bytes, anything
+    else degrades to str — a trace line must never raise."""
+    try:
+        import numpy as np
+        if isinstance(x, np.integer):
+            return int(x)
+        if isinstance(x, np.floating):
+            return float(x)
+        if isinstance(x, np.ndarray):
+            return x.tolist()
+    except Exception:
+        pass
+    if isinstance(x, bytes):
+        return x.decode("utf-8", errors="replace")
+    return str(x)
+
+
+def dumps(ev: dict) -> str:
+    return json.dumps(ev, default=_jsonable)
+
+
+class RingSink:
+    """Bounded in-memory buffer.  Locked: a snapshot (list()) taken
+    while another thread appends would raise 'deque mutated during
+    iteration' — concurrent ``-partition`` worlds emit while a reader
+    calls ``mr.stats()``/``dump_trace``."""
+
+    def __init__(self, maxlen: int = 65536):
+        self.events: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def emit(self, ev: dict) -> None:
+        with self._lock:
+            self.events.append(ev)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self.events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.events.clear()
+
+
+class JsonlSink:
+    """One JSON event per line, flushed per event so a killed run still
+    leaves a readable trace."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path, "w")
+        self._lock = threading.Lock()
+
+    def emit(self, ev: dict) -> None:
+        line = dumps(ev)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+class CallbackSink:
+    """Adapter: any ``fn(event_dict)`` as a sink."""
+
+    def __init__(self, fn: Callable[[dict], None]):
+        self.fn = fn
+
+    def emit(self, ev: dict) -> None:
+        self.fn(ev)
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export
+# ---------------------------------------------------------------------------
+
+def chrome_trace(events: List[dict]) -> dict:
+    """Wrap span events as a Chrome trace-event JSON object (the
+    Perfetto-loadable envelope).  Events already carry ph/ts/dur/pid/tid;
+    non-serializable args are scrubbed here."""
+    return {"traceEvents": json.loads(json.dumps(list(events),
+                                                 default=_jsonable)),
+            "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, events: List[dict]) -> int:
+    """Write the Chrome trace JSON; returns the event count."""
+    doc = chrome_trace(events)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Load a JSONL trace file (skipping any truncated final line from a
+    killed run)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,308 @@
+"""Thread-safe tracer with nested spans.
+
+A span records wall time, deltas of the cumulative ``runtime.Counters``
+(bytes shuffled/padded/spilled, HBM hi-water), execution tier and
+arbitrary op metadata.  Nesting is per thread (a thread-local stack), so
+``collate`` naturally parents ``aggregate``/``convert``, which parent
+the shuffle's ``exchange`` span, and the ``-partition`` universe's
+concurrent interpreter threads each get their own stack.
+
+Events are emitted to sinks already in Chrome trace-event form
+(``ph: "X"`` complete events, ``ts``/``dur`` in microseconds), so the
+JSONL file a run writes needs only wrapping in ``{"traceEvents": [...]}``
+to load in Perfetto (``sinks.chrome_trace``).
+
+Counter deltas are PROCESS-GLOBAL (the counters are shared across
+MapReduce objects, like the reference's static stats): when concurrent
+``-partition`` worlds overlap, a span may attribute another world's
+bytes to itself.  Wall time and nesting stay correct per thread.
+
+Zero-cost when disabled: ``span()`` returns the shared :data:`NULL_SPAN`
+singleton — one attribute check, no allocation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+# Counters fields snapshotted at span entry; the exit delta lands in the
+# span's args under the mapped name (only when nonzero, to keep traces
+# small).  msizemax is a hi-water, not a flow — reported as the absolute
+# hi-water at span exit when it moved during the span.
+_DELTA_FIELDS = (
+    ("cssize", "shuffle_sent_bytes"),
+    ("cspad", "shuffle_pad_bytes"),
+    ("wsize", "spill_write_bytes"),
+    ("rsize", "spill_read_bytes"),
+    ("commtime", "comm_secs"),
+)
+
+
+class _NullSpan:
+    """Shared no-op stand-in when tracing is disabled (or for the
+    ``annotate`` of a thread with no open span)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One timed region.  Use as a context manager::
+
+        with tracer.span("collate", shards=P) as sp:
+            ...
+            sp.set(nkv=n)
+    """
+
+    __slots__ = ("tracer", "name", "cat", "attrs", "span_id", "parent_id",
+                 "t0", "t1", "_snap", "_mem0", "_jax_ctx")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.span_id = 0
+        self.parent_id = 0
+        self.t0 = self.t1 = 0.0
+        self._snap = None
+        self._mem0 = 0
+        self._jax_ctx = None
+
+    def set(self, **attrs):
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        tr = self.tracer
+        self.span_id = tr._next_id()
+        stack = tr._stack()
+        self.parent_id = stack[-1].span_id if stack else 0
+        stack.append(self)
+        c = tr.counters
+        self._snap = tuple(getattr(c, f) for f, _ in _DELTA_FIELDS)
+        self._mem0 = c.msizemax
+        if tr.jax_annotations:
+            try:
+                import jax
+                self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None  # no profiler backend: spans still work
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.t1 = time.perf_counter()
+        if self._jax_ctx is not None:
+            try:
+                self._jax_ctx.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        tr = self.tracer
+        stack = tr._stack()
+        # pop self even if an inner span leaked (exception unwinding)
+        while stack and stack.pop() is not self:
+            pass
+        c = tr.counters
+        for (field, label), before in zip(_DELTA_FIELDS, self._snap):
+            d = getattr(c, field) - before
+            if d:
+                self.attrs[label] = d
+        if c.msizemax != self._mem0:
+            self.attrs["hbm_hiwater_bytes"] = c.msizemax
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        tr._emit(self)
+        return False
+
+    def event(self) -> dict:
+        """This finished span as a Chrome trace-event dict."""
+        tr = self.tracer
+        return {
+            "name": self.name, "cat": self.cat, "ph": "X",
+            "ts": round((self.t0 - tr.epoch) * 1e6, 1),
+            "dur": round((self.t1 - self.t0) * 1e6, 1),
+            "pid": tr.pid, "tid": threading.get_ident() & 0x7FFFFFFF,
+            "id": self.span_id, "parent": self.parent_id,
+            "args": self.attrs,
+        }
+
+
+class Tracer:
+    """Span factory + sink fan-out.  One per process normally
+    (:func:`get_tracer`); tests may build private instances."""
+
+    def __init__(self, counters=None):
+        if counters is None:
+            from ..core.runtime import global_counters
+            counters = global_counters()
+        self.enabled = False
+        self.counters = counters
+        self.jax_annotations = os.environ.get("MRTPU_TRACE_JAX", "1") == "1"
+        self.epoch = time.perf_counter()
+        self.pid = os.getpid()
+        self._sinks: List[object] = []
+        self._ring: Optional["RingSink"] = None
+        self._jsonl: Dict[str, object] = {}   # path → JsonlSink (dedupe)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._id = 0
+
+    # -- span construction --------------------------------------------------
+    def span(self, name: str, cat: str = "op", **attrs):
+        """A new child span of this thread's current span — or the no-op
+        singleton when disabled (the zero-cost fast path)."""
+        if not self.enabled:
+            return NULL_SPAN
+        return Span(self, name, cat, attrs)
+
+    def annotate(self, **attrs) -> None:
+        """Attach attrs to this thread's innermost open span (no-op when
+        disabled or no span is open) — how deep layers report tier/shape
+        facts without threading span objects through call signatures."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        if stack:
+            stack[-1].attrs.update(attrs)
+
+    def current(self):
+        stack = self._stack() if self.enabled else None
+        return stack[-1] if stack else None
+
+    # -- configuration ------------------------------------------------------
+    def enable(self, jsonl: Optional[str] = None, ring: Optional[int] = None):
+        """Turn tracing on.  ``jsonl``: also stream events to this path
+        (idempotent per path).  ``ring``: in-memory buffer capacity (a
+        ring is always attached; default from MRTPU_TRACE_RING or 65536).
+        Returns self for chaining."""
+        from .sinks import JsonlSink, RingSink
+        with self._lock:
+            if self._ring is None:
+                cap = ring or int(os.environ.get("MRTPU_TRACE_RING", 65536))
+                self._ring = RingSink(cap)
+                self._sinks.append(self._ring)
+            if jsonl and jsonl not in self._jsonl:
+                sink = JsonlSink(jsonl)
+                self._jsonl[jsonl] = sink
+                self._sinks.append(sink)
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def subscribe(self, fn) -> None:
+        """Register ``fn(event_dict)`` as a sink and enable tracing —
+        the external-consumer hook.  Goes through enable() so the ring
+        (and hence events()/stats()/dump_trace) works too."""
+        from .sinks import CallbackSink
+        self.enable()
+        with self._lock:
+            self._sinks.append(CallbackSink(fn))
+
+    def reset(self) -> None:
+        """Drop sinks/events and disable (test isolation)."""
+        self.enabled = False
+        with self._lock:
+            for s in self._sinks:
+                close = getattr(s, "close", None)
+                if close:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+            self._sinks = []
+            self._ring = None
+            self._jsonl = {}
+
+    # -- event access -------------------------------------------------------
+    def events(self) -> list:
+        """Snapshot of the in-memory ring (empty when never enabled)."""
+        return self._ring.snapshot() if self._ring is not None else []
+
+    def clear(self) -> None:
+        """Drop buffered ring events (sinks stay attached) — e.g. to
+        separate a warmup run from the timed run."""
+        if self._ring is not None:
+            self._ring.clear()
+
+    def stats(self) -> dict:
+        """Per-op aggregate over the ring (see report.aggregate_ops)."""
+        from .report import aggregate_ops
+        return aggregate_ops(self.events())
+
+    # -- internals ----------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _next_id(self) -> int:
+        with self._lock:
+            self._id += 1
+            return self._id
+
+    def _emit(self, span: Span) -> None:
+        ev = span.event()
+        with self._lock:
+            sinks = list(self._sinks)
+        for s in sinks:
+            try:
+                s.emit(ev)
+            except Exception:
+                # a broken sink (full disk, closed file) must never fail
+                # the traced op; drop it fully — including its jsonl
+                # dedup entry, so a later enable(jsonl=path) can attach
+                # a fresh sink instead of silently no-opping
+                with self._lock:
+                    if s in self._sinks:
+                        self._sinks.remove(s)
+                    for path, sink in list(self._jsonl.items()):
+                        if sink is s:
+                            del self._jsonl[path]
+                close = getattr(s, "close", None)
+                if close:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+
+
+def configure_from_env(tracer: Tracer) -> Tracer:
+    """Apply MRTPU_TRACE (JSONL path, or '1' for ring-only) if set."""
+    path = os.environ.get("MRTPU_TRACE")
+    if path:
+        tracer.enable(jsonl=None if path == "1" else path)
+    return tracer
+
+
+_GLOBAL: Optional[Tracer] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (created on first use; MRTPU_TRACE in
+    the environment auto-enables it)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = configure_from_env(Tracer())
+    return _GLOBAL

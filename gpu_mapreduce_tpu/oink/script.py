@@ -198,8 +198,11 @@ class OinkScript:
             self._run_registered(command, args)
             return
         if command in self.obj.named:
+            from ..obs import get_tracer
             t0 = _time.perf_counter()
-            self.dispatch.run(command, args)
+            with get_tracer().span(f"oink.{command}", cat="oink",
+                                   args=" ".join(args)):
+                self.dispatch.run(command, args)
             self.deltatime = _time.perf_counter() - t0
             return
         raise MRError(f"Unknown command: {command}")
@@ -250,9 +253,14 @@ class OinkScript:
                 f"Mismatch in command inputs: {name} takes "
                 f"{cmd.ninputs}, got {ninput_args} (use a v_name "
                 f"variable for a multi-file input)")
+        from ..obs import get_tracer
         t0 = _time.perf_counter()
         try:
-            cmd.run()
+            # every script command is one span (obs/): a script's trace
+            # reads as oink.<command> parents over the MR-op spans
+            with get_tracer().span(f"oink.{name}", cat="oink",
+                                   args=" ".join(params)):
+                cmd.run()
         finally:
             self.obj.cleanup()
         self.deltatime = _time.perf_counter() - t0

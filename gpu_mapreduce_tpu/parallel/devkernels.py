@@ -166,7 +166,10 @@ def _concat_jit(mesh):
 
 def _merge_decode(ta, tb, what: str):
     """Union two id→key/value intern tables (None means plain ids; mixing
-    plain with interned would merge two incompatible spaces)."""
+    plain with interned would merge two incompatible spaces).  Tables of
+    DIFFERENT kinds (bytes vs object) must be domain-aligned first —
+    concat_sharded re-interns the bytes-kind side through the pickle
+    domain before calling here (:func:`_align_domains`)."""
     if (ta is None) != (tb is None):
         raise ValueError(
             f"cannot add an interned byte/object-{what}ed mesh dataset "
@@ -184,20 +187,104 @@ def _merge_decode(ta, tb, what: str):
     return InternTable({**ta, **tb}, kind=kind)
 
 
+@functools.lru_cache(maxsize=None)
+def _remap_ids_jit(mesh, m: int):
+    """old-id → new-id elementwise remap against a replicated sorted
+    lookup of length m (pow2-padded); ids absent from the lookup pass
+    through unchanged (padding rows beyond counts)."""
+    from jax.sharding import NamedSharding
+
+    @functools.partial(jax.jit,
+                       out_shardings=NamedSharding(mesh, row_spec(mesh)))
+    def run(col, old_sorted, new_by_old):
+        pos = jnp.clip(jnp.searchsorted(old_sorted, col), 0, m - 1)
+        hit = old_sorted[pos] == col
+        return jnp.where(hit, new_by_old[pos], col)
+
+    return run
+
+
+def _reintern_pickle_domain(col, table, mesh):
+    """Re-intern a bytes-kind decode table + its device id column through
+    the PICKLE id domain (the object tier's): every stored bytes row
+    re-hashes over its pickle — exactly what _intern_side's
+    BytesColumn→ObjectColumn promotion does at ingest
+    (parallel/ingest.py) — and the id column remaps old→new in one
+    jitted lookup.  Returns (new column, new object-kind table)."""
+    import pickle
+    from jax.sharding import NamedSharding
+    from ..core.column import InternTable, ShardTables, _intern_core
+    from .sharded import round_cap
+    old_ids = np.fromiter(table.keys(), np.uint64, len(table))
+    if not len(old_ids):
+        empty = (ShardTables(table.P, kind="object")
+                 if isinstance(table, ShardTables)
+                 else InternTable(kind="object"))
+        return col, empty
+    rows = (table.decode_batch(old_ids) if hasattr(table, "decode_batch")
+            else [table[int(h)] for h in old_ids])
+    probes = [pickle.dumps(r, protocol=4) for r in rows]
+    new_ids, uniq, first = _intern_core(probes)
+    if isinstance(table, ShardTables):
+        newt = ShardTables(table.P, kind="object")
+        newt.absorb(uniq, [rows[int(i)] for i in first],
+                    probes=[probes[int(i)] for i in first])
+    else:
+        newt = InternTable(((int(new_ids[int(i)]), rows[int(i)])
+                            for i in first), kind="object")
+    order = np.argsort(old_ids)
+    # pow2-padded replicated lookup (sentinel never matches a real id)
+    # so recompiles stay bounded, like sort_interned_sharded's surrogate
+    m = len(old_ids)
+    mcap = round_cap(m)
+    old_sorted = np.full(mcap, U64MAX, np.uint64)
+    new_by_old = np.full(mcap, U64MAX, np.uint64)
+    old_sorted[:m] = old_ids[order]
+    new_by_old[:m] = new_ids[order]
+    rep = NamedSharding(mesh, P())
+    out = _remap_ids_jit(mesh, mcap)(col,
+                                     jax.device_put(old_sorted, rep),
+                                     jax.device_put(new_by_old, rep))
+    return out, newt
+
+
+def _align_domains(a: ShardedKV, b: ShardedKV, which: str):
+    """Cross-domain id alignment before a concat (ADVICE r5): a
+    bytes-kind table's ids hash RAW BYTES while an object-kind table's
+    hash PICKLES, so the same logical key concatenated from a bytes-keyed
+    and an object-keyed dataset would carry two distinct u64 ids and
+    never group.  When the kinds differ, the bytes-kind side re-interns
+    through the pickle domain so both datasets share one id space."""
+    ta = a.key_decode if which == "key" else a.value_decode
+    tb = b.key_decode if which == "key" else b.value_decode
+    ca = a.key if which == "key" else a.value
+    cb = b.key if which == "key" else b.value
+    if ta is None or tb is None or \
+            getattr(ta, "kind", "bytes") == getattr(tb, "kind", "bytes"):
+        return ca, cb, ta, tb
+    if getattr(ta, "kind", "bytes") == "bytes":
+        ca, ta = _reintern_pickle_domain(ca, ta, a.mesh)
+    else:
+        cb, tb = _reintern_pickle_domain(cb, tb, b.mesh)
+    return ca, cb, ta, tb
+
+
 def concat_sharded(a: ShardedKV, b: ShardedKV) -> ShardedKV:
     """Per-shard concatenation of two mesh KV datasets (the device path of
-    ``MapReduce::add``, src/mapreduce.cpp:348-374)."""
+    ``MapReduce::add``, src/mapreduce.cpp:348-374).  Differing intern
+    domains (bytes-kind vs object-kind tables) align through the pickle
+    domain first, so equal logical keys from the two datasets group after
+    the concat (:func:`_align_domains`, ADVICE r5)."""
     assert a.mesh is b.mesh or a.mesh == b.mesh
+    ak, bk, kta, ktb = _align_domains(a, b, "key")
+    av, bv, vta, vtb = _align_domains(a, b, "value")
     put = lambda s: jax.device_put(s.counts.astype(np.int32),
                                    row_sharding(a.mesh))
-    k, v, c = _concat_jit(a.mesh)(a.key, a.value, put(a), b.key, b.value,
-                                  put(b))
+    k, v, c = _concat_jit(a.mesh)(ak, av, put(a), bk, bv, put(b))
     SyncStats.bump()
     return ShardedKV(a.mesh, k, v, np.asarray(c).astype(np.int32),
-                     key_decode=_merge_decode(a.key_decode, b.key_decode,
-                                              "key"),
-                     value_decode=_merge_decode(a.value_decode,
-                                                b.value_decode, "value"))
+                     key_decode=_merge_decode(kta, ktb, "key"),
+                     value_decode=_merge_decode(vta, vtb, "value"))
 
 
 def clone_sharded(skv: ShardedKV) -> ShardedKMV:

@@ -79,18 +79,21 @@ def run_sinks(payloads, call: Callable, threaded: bool = True,
     from MPI); assembly order is by task index either way, so the
     result is deterministic regardless of scheduling."""
     from ..core.mapreduce import _TaskSink
+    from ..obs import get_tracer
     sinks = [_TaskSink() for _ in payloads]
-    if not threaded or len(payloads) <= 1:
-        for i, p in enumerate(payloads):
-            call(base + i, p, sinks[i])
-        return sinks
-    from concurrent.futures import ThreadPoolExecutor
-    nworkers = max(1, min((os.cpu_count() or 4), 16, len(payloads)))
-    with ThreadPoolExecutor(nworkers) as pool:
-        futs = [pool.submit(call, base + i, p, sinks[i])
-                for i, p in enumerate(payloads)]
-        for f in futs:
-            f.result()   # propagate callback exceptions
+    with get_tracer().span("ingest.read", cat="ingest",
+                           ntasks=len(payloads), threaded=threaded):
+        if not threaded or len(payloads) <= 1:
+            for i, p in enumerate(payloads):
+                call(base + i, p, sinks[i])
+            return sinks
+        from concurrent.futures import ThreadPoolExecutor
+        nworkers = max(1, min((os.cpu_count() or 4), 16, len(payloads)))
+        with ThreadPoolExecutor(nworkers) as pool:
+            futs = [pool.submit(call, base + i, p, sinks[i])
+                    for i, p in enumerate(payloads)]
+            for f in futs:
+                f.result()   # propagate callback exceptions
     return sinks
 
 
@@ -177,26 +180,30 @@ def _put_blocks(blocks: List[np.ndarray], cap: int, mesh):
     bounded messages (mesh.h2d_chunk_bytes — honors MR_H2D_CHUNK_WORDS
     like every other chunked-transfer site); assemble the row-sharded
     global [P*cap,...]."""
+    from ..obs import get_tracer
     from .mesh import h2d_chunk_bytes, row_sharding
     P = len(blocks)
     sharding = row_sharding(mesh)
     shape = (P * cap,) + blocks[0].shape[1:]
     dmap = sharding.addressable_devices_indices_map(shape)
     budget = h2d_chunk_bytes(H2D_CHUNK_BYTES)
-    shards = []
-    for dev, idx in dmap.items():
-        p = (idx[0].start or 0) // cap
-        host = np.ascontiguousarray(blocks[p])
-        rowbytes = max(1, int(host.nbytes // max(1, cap)))
-        chunk = max(1, budget // rowbytes)
-        if cap > chunk:
-            import jax.numpy as jnp
-            parts = [jax.device_put(host[o:o + chunk], dev)
-                     for o in range(0, cap, chunk)]
-            shards.append(jnp.concatenate(parts))
-        else:
-            shards.append(jax.device_put(host, dev))
-    return jax.make_array_from_single_device_arrays(shape, sharding, shards)
+    with get_tracer().span("ingest.h2d", cat="ingest", shards=P,
+                           bytes=int(sum(b.nbytes for b in blocks))):
+        shards = []
+        for dev, idx in dmap.items():
+            p = (idx[0].start or 0) // cap
+            host = np.ascontiguousarray(blocks[p])
+            rowbytes = max(1, int(host.nbytes // max(1, cap)))
+            chunk = max(1, budget // rowbytes)
+            if cap > chunk:
+                import jax.numpy as jnp
+                parts = [jax.device_put(host[o:o + chunk], dev)
+                         for o in range(0, cap, chunk)]
+                shards.append(jnp.concatenate(parts))
+            else:
+                shards.append(jax.device_put(host, dev))
+        return jax.make_array_from_single_device_arrays(shape, sharding,
+                                                        shards)
 
 
 def build_sharded(frames: List[KVFrame], mesh):

@@ -359,7 +359,23 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
              counters=None) -> ShardedKV:
     """Full ragged exchange: route every valid row to its dest shard.
     ``dest`` is a hashable spec (see :func:`_dest_fn`).  The intern table
-    of byte-keyed datasets rides along (ids move, bytes stay put)."""
+    of byte-keyed datasets rides along (ids move, bytes stay put).
+
+    Emits a ``shuffle.exchange`` child span (obs/) under the calling MR
+    op carrying the flow-control telemetry (bucket/rounds/caps, useful
+    vs padding bytes, whether the speculative caps held)."""
+    from ..obs import NULL_SPAN, get_tracer
+    tr = get_tracer()
+    if not tr.enabled:
+        return _exchange_impl(skv, dest, transport, counters, NULL_SPAN)
+    with tr.span("shuffle.exchange", cat="shuffle",
+                 nprocs=mesh_axis_size(skv.mesh),
+                 transport=transport) as sp:
+        return _exchange_impl(skv, dest, transport, counters, sp)
+
+
+def _exchange_impl(skv: ShardedKV, dest, transport: int,
+                   counters, sp) -> ShardedKV:
     mesh = skv.mesh
     nprocs = mesh_axis_size(mesh)
 
@@ -383,7 +399,11 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         out_spec = _phase2_jit(mesh, transport, *spec)(
             skey, svalue, counts_local)
     SyncStats.bump()   # the op's ONE round-trip: the count matrix
-    counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
+    from ..obs import get_tracer
+    with get_tracer().span("shuffle.count_sync", cat="shuffle"):
+        # the host pull that sizes the exchange — with a speculative
+        # phase 2 in flight this overlaps device work
+        counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
     # round budget: pad buckets to ~the mean nonzero bucket, not the max —
     # under key skew (RMAT hubs) the max bucket is far above the mean and
     # single-round padding would inflate the exchanged volume by that
@@ -396,6 +416,7 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         # speculation holds: no row would have overflowed a bucket
         # window or an output shard — keep the already-running result
         out_k, out_v = out_spec
+        sp.set(speculative=True)
         oversized = (spec[0] * spec[1] > 4 * max(Bmax, 8)
                      or spec[2] > 4 * round_cap(nmax_out))
         # a grossly over-sized speculation right-sizes the cache for
@@ -405,6 +426,7 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
                 else spec
         B, nrounds, cap_out = spec
     else:
+        sp.set(speculative=False)
         out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out)(
             skey, svalue, counts_local)
         with _SPEC_LOCK:
@@ -414,6 +436,8 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
     # here, but a reader then sees ONE exchange's (nrounds, bucket) pair,
     # never a torn mix (VERDICT r4 weak #7)
     ExchangeStats.last = (nrounds, B)
+    sp.set(bucket=B, nrounds=nrounds, cap_out=cap_out,
+           rows=int(counts_mat.sum()))
     if counters is not None:
         rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
                     skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
@@ -427,6 +451,7 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
         sent_slots = nprocs * (nprocs - 1) * B * nrounds
         pad = max(0, sent_slots - useful) * rowbytes
         counters.add(cssize=moved, crsize=moved, cspad=pad)
+        sp.set(sent_bytes=moved, pad_bytes=pad, rowbytes=rowbytes)
     return ShardedKV(mesh, out_k, out_v, new_counts,
                      key_decode=skv.key_decode,
                      value_decode=skv.value_decode)

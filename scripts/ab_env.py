@@ -12,11 +12,17 @@ exists.  Prints nothing (exit 0) when there is no on-chip best row:
 stale CPU-interpret matrices must not steer the chip.
 """
 import json
+import os
 import sys
+
+# resolve relative to this file like the sibling scripts — a hardcoded
+# absolute path breaks any other checkout location (ADVICE r5)
+AB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "..", "TPU_AB.json")
 
 def main() -> int:
     try:
-        with open("/root/repo/TPU_AB.json") as f:
+        with open(AB_PATH) as f:
             rec = json.load(f)
     except (OSError, ValueError):
         return 0

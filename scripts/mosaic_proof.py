@@ -17,12 +17,13 @@ Run standalone or from scripts/tpu_watch.sh.  Exits nonzero unless the
 kernel really compiled and ran on a TPU backend with interpret=False.
 """
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-REPO = "/root/repo"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 

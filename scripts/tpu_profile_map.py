@@ -22,7 +22,7 @@ import os
 import sys
 import time
 
-REPO = "/root/repo"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 if os.environ.get("JAX_PLATFORMS") == "cpu":

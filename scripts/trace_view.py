@@ -1,0 +1,62 @@
+"""Summarize a JSONL span trace: per-op time/bytes table.
+
+Usage:
+    python scripts/trace_view.py TRACE.jsonl [--chrome OUT.json]
+                                             [--cat CAT] [--json]
+
+TRACE.jsonl is what a run writes under MRTPU_TRACE=path (or
+MapReduce(trace=path)).  --chrome additionally writes the
+Perfetto-loadable Chrome trace-event file; --cat filters to one span
+category (mr_op / shuffle / ingest / oink / app / soak); --json prints
+the aggregate as JSON instead of the table.
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def main(argv) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 1
+    path = argv[0]
+    chrome = None
+    cat = None
+    as_json = False
+    i = 1
+    while i < len(argv):
+        if argv[i] in ("--chrome", "--cat"):
+            if i + 1 >= len(argv):
+                print(f"{argv[i]} needs a value", file=sys.stderr)
+                return 1
+            if argv[i] == "--chrome":
+                chrome = argv[i + 1]
+            else:
+                cat = argv[i + 1]
+            i += 2
+        elif argv[i] == "--json":
+            as_json = True
+            i += 1
+        else:
+            print(f"unknown argument: {argv[i]}", file=sys.stderr)
+            return 1
+    from gpu_mapreduce_tpu.obs import (aggregate_ops, per_op_table,
+                                       read_jsonl, write_chrome_trace)
+    events = read_jsonl(path)
+    if cat:
+        events = [e for e in events if e.get("cat") == cat]
+    if as_json:
+        print(json.dumps(aggregate_ops(events), indent=2))
+    else:
+        print(per_op_table(events))
+    if chrome:
+        n = write_chrome_trace(chrome, events)
+        print(f"\nwrote {n} events -> {chrome}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -48,12 +48,19 @@ def main():
     published = {}
     errors = {}
 
+    # every workload runs under a soak.<name> span; the end-of-run
+    # per-op table comes from the same tracer the library reports into
+    # (MRTPU_TRACE additionally streams the JSONL trace file)
+    from gpu_mapreduce_tpu.obs import get_tracer, per_op_table
+    tracer = get_tracer().enable()
+
     def guard(name, fn):
         """One workload failing (a Mosaic rejection, a tunnel drop
         mid-compile) must not forfeit the other rows — the flaky-tunnel
         lesson of rounds 1-2 applied per workload."""
         try:
-            fn()
+            with tracer.span("soak." + name, cat="soak"):
+                fn()
         except Exception as e:
             import traceback
             errors[name] = repr(e)[:300]
@@ -269,6 +276,9 @@ def main():
     guard("pagerank_northstar", do_pagerank_northstar)
     if errors:
         published["errors"] = errors
+
+    print("\nper-op trace summary (obs/):")
+    print(per_op_table(tracer.events()))
 
     published["backend"] = backend
     published["rmat_scale"] = scale

@@ -487,3 +487,35 @@ def test_exchange_speculative_caps(mesh, monkeypatch):
     assert len(calls) in (5, 6)     # hit (maybe oversized) or re-run
     if len(calls) == 5:             # held: cache must right-size if gross
         assert spec_after[2] <= 4 * calls[0][2]
+
+
+def test_add_cross_domain_keys_group(mesh):
+    """ADVICE r5 regression: a bytes-keyed dataset added to an
+    object-keyed one must carry ONE id per logical key — the bytes-kind
+    side re-interns through the pickle domain at concat
+    (devkernels._align_domains), so equal keys group after collate."""
+    mr1 = MapReduce(mesh)
+    mr1.map(1, lambda i, kv, p: [kv.add(b"x", 1), kv.add(b"y", 2)])
+    mr1.aggregate()
+    assert mr1.kv.one_frame().key_decode.kind == "bytes"
+
+    mr2 = MapReduce(mesh)
+    # a tuple key forces the object tier, so b"x" here hashes over its
+    # PICKLE — a different u64 than mr1's raw-bytes hash
+    mr2.map(1, lambda i, kv, p: [kv.add(b"x", 3), kv.add((1, "t"), 4)])
+    mr2.aggregate()
+    assert mr2.kv.one_frame().key_decode.kind == "object"
+
+    mr1.add(mr2)
+    mr1.collate()
+    groups = {}
+
+    def take(k, vals, kv, ptr):
+        key = tuple(k) if isinstance(k, (list, tuple)) else k
+        groups[key] = sorted(int(v) for v in vals)
+        kv.add(0, len(vals))
+
+    mr1.reduce(take)
+    assert groups[b"x"] == [1, 3]          # ONE group across both domains
+    assert groups[b"y"] == [2]
+    assert groups[(1, "t")] == [4]
