@@ -291,6 +291,58 @@ def _knobs():
     return _env_knobs()
 
 
+FUSE_MODE = None   # --fuse {0,1,ab} (or BENCH_FUSE); None = skip A/B
+
+
+def plan_ab_record(mode: str, comm) -> dict:
+    """Eager-vs-fused A/B of the canonical map→aggregate→convert→reduce
+    pipeline (plan/ subsystem, doc/plan.md): wall time + compiled-program
+    dispatch counts per variant.  Each variant runs twice — the first
+    run pays compiles (both tiers share jit caches), the second is the
+    steady state the headline numbers quote; the fused second run also
+    shows the plan-cache hit.  Outputs must agree across variants or the
+    record carries an "error" instead of a bogus win."""
+    import numpy as np
+    from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+    from gpu_mapreduce_tpu.core.runtime import global_counters
+    from gpu_mapreduce_tpu.ops.reduces import count
+    from gpu_mapreduce_tpu.plan import plan_cache
+
+    n = int(os.environ.get("BENCH_PLAN_ROWS", 1 << 20))
+    keys = (np.arange(n, dtype=np.uint64) * 2654435761) % max(n // 8, 1)
+    vals = np.ones(n, np.int64)
+
+    def run(fuse: int) -> dict:
+        mr = MapReduce(comm, fuse=fuse)
+        mr.kv = mr._new_kv()
+        mr.kv.add_batch(keys, vals)
+        mr.kv.complete()
+        c0 = global_counters().snapshot()["ndispatch"]
+        t0 = time.perf_counter()
+        mr.aggregate()
+        mr.convert()
+        nunique = int(mr.reduce(count, batch=True))  # int() = barrier
+        dt = time.perf_counter() - t0
+        d = global_counters().snapshot()["ndispatch"] - c0
+        return {"wall_s": round(dt, 4), "dispatches": d,
+                "nunique": nunique}
+
+    out = {"rows": n, "mode": mode}
+    results = {}
+    for label, fuse in (("eager", 0), ("fused", 1)):
+        if mode != "ab" and mode != str(fuse):
+            continue
+        first = run(fuse)
+        second = run(fuse)
+        results[label] = second["nunique"]
+        out[label] = {**second, "first_run_wall_s": first["wall_s"]}
+    if mode in ("1", "ab"):
+        out["plan_cache"] = plan_cache().stats()
+    if len(set(results.values())) > 1:
+        out["error"] = f"variant outputs disagree: {results}"
+    return out
+
+
 def run_bench(engine, backend_err):
     total_mb = int(os.environ.get("BENCH_MB", "256"))
     skew = os.environ.get("BENCH_SKEW", "0") == "1"
@@ -366,6 +418,21 @@ def run_bench(engine, backend_err):
         # from the obs/ tracer — the machine-readable twin of stages_sec
         "trace_ops": aggregate_ops(tracer.events()),
     }
+    if FUSE_MODE:
+        # --fuse {0,1,ab}: eager-vs-fused plan A/B of the canonical
+        # pipeline; failures must not cost the headline metric line
+        ab_comm = comm
+        if ab_comm is None:
+            try:
+                from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+                ab_comm = make_mesh(1)
+            except Exception:
+                ab_comm = None
+        try:
+            detail["plan_ab"] = plan_ab_record(FUSE_MODE, ab_comm)
+        except Exception:
+            detail["plan_ab"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     try:
         print(json.dumps({"detail": detail}), file=sys.stderr)
     except Exception:
@@ -377,6 +444,15 @@ def run_bench(engine, backend_err):
 
 
 def main():
+    global FUSE_MODE
+    argv = sys.argv[1:]
+    if "--fuse" in argv:
+        i = argv.index("--fuse")
+        FUSE_MODE = argv[i + 1] if i + 1 < len(argv) else "ab"
+    else:
+        FUSE_MODE = os.environ.get("BENCH_FUSE") or None
+    if FUSE_MODE not in (None, "0", "1", "ab"):
+        raise SystemExit(f"--fuse takes 0, 1 or ab, got {FUSE_MODE!r}")
     backend_err = None
     try:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))

@@ -91,6 +91,54 @@ class _TaskSink:
         self._calls.clear()
 
 
+def _fusible(fn):
+    """Defer this op into the plan recorder (plan/) when one is active —
+    either an explicit ``with mr.pipeline():`` block or the ``fuse=1``
+    setting (MRTPU_FUSE).  The deferred call returns a lazy
+    :class:`~..plan.recorder.PendingCount`; barriers (maps, gather,
+    scans, print, stats, save/load, copy) flush the plan, and the fuser
+    replays any non-fusible stage through the undeferred method —
+    ``_plan_replaying`` guards that re-entry."""
+    op = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kw):
+        if not self._plan_replaying:
+            if not _defer_ok(op, args, kw):
+                # user-callback ops (host reduces, ptr-carrying calls,
+                # comparator sorts) can have arbitrary Python side
+                # effects the caller observes right after the call —
+                # the sssp shape reduce(f, ptr=open_mr), closure
+                # counters — and they never fuse anyway: they are a
+                # barrier, not a recorded stage
+                self._flush_plan()
+                return fn(self, *args, **kw)
+            rec = self._plan
+            if rec is None and self.settings.fuse:
+                from ..plan.recorder import PlanRecorder
+                rec = self._plan = PlanRecorder(self, auto=True)
+            if rec is not None:
+                return rec.record(op, args, kw)
+        return fn(self, *args, **kw)
+    return wrapper
+
+
+def _defer_ok(op: str, args: tuple, kw: dict) -> bool:
+    """Only ops that could possibly fuse are worth deferring: aggregate,
+    convert, int-flag sorts and registered-kernel reduces.  Anything
+    carrying a user callback runs as a barrier instead."""
+    if op in ("sort_keys", "sort_values"):
+        arg = args[0] if args else kw.get("flag_or_cmp", 1)
+        return not callable(arg)
+    if op != "reduce":
+        return True          # aggregate / convert
+    if kw.get("ptr") is not None or (len(args) > 1 and args[1] is not None):
+        return False
+    fn = args[0] if args else kw.get("func")
+    from ..plan.fuser import _kernel_op
+    return fn is not None and _kernel_op(fn) is not None
+
+
 def _traced(fn):
     """Wrap an MR op in a tracer span (gpu_mapreduce_tpu/obs): wall
     time, counter deltas (shuffle/pad/spill bytes, HBM hi-water) and the
@@ -139,10 +187,13 @@ class MapReduce:
             # a jax.sharding.Mesh → distributed backend (parallel/)
             from ..parallel.backend import MeshBackend
             self.backend = MeshBackend(comm)
-        self.kv: Optional[KeyValue] = None
-        self.kmv: Optional[KeyMultiValue] = None
+        self._kv_data: Optional[KeyValue] = None
+        self._kmv_data: Optional[KeyMultiValue] = None
         self._open = False
         self._last_stats: dict = {}
+        self._plan = None              # active plan recorder (plan/)
+        self._plan_replaying = False   # fuser is replaying a stage
+        self.last_exchange = None      # per-call ExchangeCallStats
         # which path the last file map took ({"mode": "mesh"|"host", …},
         # parallel/ingest.py); None-mode until a file map runs
         self.last_ingest: dict = {"mode": None}
@@ -164,7 +215,102 @@ class MapReduce:
             setattr(candidate, k, v)
         candidate.validate(self.error)  # raises before touching live settings
         self.settings = candidate
+        # turning fusion off is a barrier: an active fuse=1 auto
+        # recorder must not keep deferring past the user's fuse=0
+        if not candidate.fuse and self._plan is not None and self._plan.auto:
+            self._flush_plan()
         return self
+
+    # ------------------------------------------------------------------
+    # datasets: reading kv/kmv is a plan barrier (plan/) — any pending
+    # deferred chain materializes first, so direct readers (apps, oink
+    # commands, checkpoint, user code) never see stale/None state under
+    # fuse=1.  During plan execution the recorder's stage list is empty
+    # (recorder.flush swaps it out first), so these reads cost nothing.
+    # ------------------------------------------------------------------
+    @property
+    def kv(self) -> Optional[KeyValue]:
+        rec = self.__dict__.get("_plan")
+        if rec is not None and rec.stages:
+            self._flush_plan()
+        return self._kv_data
+
+    @kv.setter
+    def kv(self, value: Optional[KeyValue]) -> None:
+        # writes are barriers too: pending deferred ops were issued
+        # against the OLD dataset — eager semantics would have run them
+        # before the caller's assignment, so run them now
+        rec = self.__dict__.get("_plan")
+        if rec is not None and rec.stages:
+            self._flush_plan()
+        self._kv_data = value
+
+    @property
+    def kmv(self) -> Optional[KeyMultiValue]:
+        rec = self.__dict__.get("_plan")
+        if rec is not None and rec.stages:
+            self._flush_plan()
+        return self._kmv_data
+
+    @kmv.setter
+    def kmv(self, value: Optional[KeyMultiValue]) -> None:
+        rec = self.__dict__.get("_plan")
+        if rec is not None and rec.stages:
+            self._flush_plan()
+        self._kmv_data = value
+
+    # ------------------------------------------------------------------
+    # lazy pipeline recording (plan/)
+    # ------------------------------------------------------------------
+    def pipeline(self):
+        """Record the ops issued inside the block and run them fused::
+
+            with mr.pipeline():
+                mr.aggregate(); mr.convert(); mr.reduce(count, batch=True)
+
+        Exit (or any barrier op) fuses maximal device-tier runs into
+        single compiled programs via the plan cache; non-fusible stages
+        fall back to the eager path.  The same recording starts
+        implicitly per-op under ``fuse=1`` / ``MRTPU_FUSE=1``."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from ..plan.recorder import PlanRecorder
+            prev = self._plan
+            rec = self._plan = PlanRecorder(self)
+            if prev is not None:
+                # adopt a pre-existing recorder's pending stages (e.g.
+                # fuse=1 deferred an aggregate before this block) so
+                # they execute in issue order — and may fuse with ours
+                rec.stages, prev.stages = prev.stages, []
+                if prev.auto:
+                    prev = None
+            try:
+                yield rec
+            except BaseException:
+                # abort, don't run heavy deferred compute mid-unwind or
+                # let a replay error mask the user's exception: the
+                # un-flushed tail is discarded (prefixes a mid-block
+                # barrier already flushed stay applied)
+                rec.stages.clear()
+                raise
+            finally:
+                if self._plan is rec:
+                    self._plan = prev
+                rec.flush()
+        return _ctx()
+
+    def _flush_plan(self) -> None:
+        """Execute any pending recorded plan (the barrier hook).  Auto
+        recorders (fuse=1) uninstall; an explicit pipeline() recorder
+        stays installed and keeps recording after the barrier."""
+        rec = self._plan
+        if rec is None:
+            return
+        if rec.auto:
+            self._plan = None
+        rec.flush()
 
     # ------------------------------------------------------------------
     # internal helpers
@@ -176,16 +322,19 @@ class MapReduce:
         return KeyMultiValue(self.settings, self.error, self.counters)
 
     def _require_kv(self, op: str) -> KeyValue:
+        self._flush_plan()   # barrier: readers need the real dataset
         if self.kv is None or not self.kv.complete_done:
             self.error.all(f"Cannot {op} without completed KeyValue")
         return self.kv
 
     def _require_kmv(self, op: str) -> KeyMultiValue:
+        self._flush_plan()
         if self.kmv is None:
             self.error.all(f"Cannot {op} without KeyMultiValue")
         return self.kmv
 
     def _start_map(self, addflag: int) -> KeyValue:
+        self._flush_plan()   # a new map consumes/replaces the dataset
         if self.kmv is not None:
             self.kmv.free()
             self.kmv = None
@@ -449,6 +598,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # shuffle / distribution ops
     # ------------------------------------------------------------------
+    @_fusible
     @_traced
     def aggregate(self, hash_fn: Optional[Callable] = None) -> int:
         """THE shuffle: each key to one proc — user hash or
@@ -546,6 +696,7 @@ class MapReduce:
         newkv.complete_done = True
         self.kv = newkv
 
+    @_fusible
     @_traced
     def convert(self) -> int:
         """Local KV→KMV grouping (reference src/mapreduce.cpp:861-886 →
@@ -635,6 +786,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # reduce family
     # ------------------------------------------------------------------
+    @_fusible
     @_traced
     def reduce(self, func: Callable, ptr=None, batch: bool = False,
                block_rows: Optional[int] = None) -> int:
@@ -731,6 +883,7 @@ class MapReduce:
         src/mapreduce.cpp:1671-1761; type decoders keyvalue.cpp:773-835).
         kflag/vflag are accepted for API parity; columns self-describe, so
         they only force integer/float/string formatting when >=0."""
+        self._flush_plan()
         out = sys.stdout if file is None else (open(file, "a") if fflag else open(file, "w"))
         try:
             if self.kv is not None:
@@ -755,6 +908,7 @@ class MapReduce:
     # ------------------------------------------------------------------
     # sorting (reference src/mapreduce.cpp:2102-2352)
     # ------------------------------------------------------------------
+    @_fusible
     @_traced
     def sort_keys(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Per-proc sort of KV by key.  int flag: |flag| selects the
@@ -764,6 +918,7 @@ class MapReduce:
         (appcompare)."""
         return self._sort_kv(by="key", flag_or_cmp=flag_or_cmp)
 
+    @_fusible
     @_traced
     def sort_values(self, flag_or_cmp: Union[int, Callable] = 1) -> int:
         """Per-proc sort of KV by value (reference src/mapreduce.cpp:2152)."""
@@ -884,6 +1039,7 @@ class MapReduce:
     def add(self, mr: "MapReduce") -> int:
         """Append mr's KV pairs to my KV (reference
         src/mapreduce.cpp:348-374)."""
+        self._flush_plan()
         src = mr._require_kv("add from")
         if self.kv is None:
             self.kv = self._new_kv()
@@ -895,6 +1051,7 @@ class MapReduce:
     def copy(self) -> "MapReduce":
         """Deep copy: new MR with copied settings and data (reference
         src/mapreduce.cpp:269-342)."""
+        self._flush_plan()
         mr = MapReduce()
         mr.backend = self.backend
         mr.settings = _copymod.deepcopy(self.settings)
@@ -930,6 +1087,7 @@ class MapReduce:
         """Global pair/byte counts; level ≥ 2 adds the per-shard histogram
         (reference kv_stats verbosity=2, src/mapreduce.cpp:2937-2968 via
         write_histo — how imbalance/corruption is detected)."""
+        self._flush_plan()
         kv = self.kv
         if kv is None:
             return (0, 0)
@@ -944,6 +1102,7 @@ class MapReduce:
         return (n, nb)
 
     def kmv_stats(self, level: int = 0) -> tuple:
+        self._flush_plan()
         kmv = self.kmv
         if kmv is None:
             return (0, 0, 0)
@@ -965,6 +1124,7 @@ class MapReduce:
     def save(self, path: str) -> int:
         """Checkpoint the current KV or KMV to a directory; returns the
         number of frames written (core/checkpoint.py)."""
+        self._flush_plan()
         from .checkpoint import save as _save
         return _save(self, path)
 
@@ -972,18 +1132,24 @@ class MapReduce:
     def load(self, path: str) -> int:
         """Replace the dataset with a checkpoint; returns the global
         pair/group count."""
+        self._flush_plan()
         from .checkpoint import load as _load
         return _load(self, path)
 
     def stats(self) -> dict:
         """The structured cumulative snapshot that ``cummulative_stats``
         prints: every Counters field by name (msizemax, rsize, wsize,
-        cssize, crsize, cspad, commtime, msize), plus — when tracing is
-        enabled (obs/) — an ``"ops"`` per-op aggregate over the span
-        ring (count / total_s / byte sums per op name)."""
+        cssize, crsize, cspad, commtime, msize, ndispatch), plus — when
+        tracing is enabled (obs/) — an ``"ops"`` per-op aggregate over
+        the span ring (count / total_s / byte sums per op name), plus a
+        ``"plan"`` section with the compile-cache telemetry (plan cache
+        + bounded shuffle jit caches: hits/misses/evictions)."""
+        self._flush_plan()   # barrier: counters must include the chain
         out = self.counters.snapshot()
         if self.tracer.enabled:
             out["ops"] = self.tracer.stats()
+        from ..plan.cache import cache_stats
+        out["plan"] = cache_stats()
         return out
 
     def cummulative_stats(self, level: int = 1, reset: int = 0):

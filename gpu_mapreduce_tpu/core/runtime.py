@@ -69,12 +69,19 @@ class Settings:
     valuealign: int = 8
     fpath: str = field(default_factory=lambda: os.environ.get(
         "MRTPU_FPATH", "."))  # spill-file dir (reference MRMPI_FPATH)
+    # 1 = defer op chains into the plan/ recorder and run them fused
+    # (no reference analog — the reference is eager by construction);
+    # the MRTPU_FUSE env var flips the default like MRTPU_MEMSIZE does
+    fuse: int = field(default_factory=lambda: int(
+        os.environ.get("MRTPU_FUSE", 0)))
 
     def validate(self, error: Error):
         if self.memsize <= 0:
             error.all("Invalid memsize setting")
         if self.mapstyle not in (0, 1, 2):
             error.all("Invalid mapstyle setting")
+        if self.fuse not in (0, 1):
+            error.all("Invalid fuse setting")
         for a in (self.keyalign, self.valuealign):
             if a <= 0 or (a & (a - 1)):
                 error.all("Alignment setting must be power of 2")
@@ -97,6 +104,9 @@ class Counters:
     #                         slack: [P,B]-buckets minus real rows —
     #                         the weak-scaling "network volume" diagnosis)
     commtime: float = 0.0   # seconds in collectives
+    ndispatch: int = 0      # compiled-program launches (jitted shuffle/
+    #                         convert/reduce/sort programs + fused plans)
+    #                         — what plan/ fusion is meant to shrink
 
     def __post_init__(self):
         import threading
@@ -121,7 +131,8 @@ class Counters:
             return {"msize": self.msize, "msizemax": self.msizemax,
                     "rsize": self.rsize, "wsize": self.wsize,
                     "cssize": self.cssize, "crsize": self.crsize,
-                    "cspad": self.cspad, "commtime": self.commtime}
+                    "cspad": self.cspad, "commtime": self.commtime,
+                    "ndispatch": self.ndispatch}
 
 
 class Timer:
@@ -168,3 +179,10 @@ _GLOBAL_COUNTERS = Counters()
 
 def global_counters() -> Counters:
     return _GLOBAL_COUNTERS
+
+
+def bump_dispatch(n: int = 1) -> None:
+    """Count one compiled-program launch (the jitted shuffle/convert/
+    reduce/sort programs and fused plan programs all report here) —
+    the denominator of the plan/ fusion win (bench detail.plan_ab)."""
+    _GLOBAL_COUNTERS.add(ndispatch=n)

@@ -42,9 +42,10 @@ class OutputDescriptor:
 class ObjectManager:
     """Holds named MRs, temporaries, descriptors, and MR defaults."""
 
-    # settings the `set` script command may override (doc: oinkdoc/set.txt)
+    # settings the `set` script command may override (doc: oinkdoc/set.txt;
+    # `fuse` is ours — plan/ fused pipelines, doc/plan.md)
     MR_SETTINGS = ("verbosity", "timer", "memsize", "outofcore", "minpage",
-                   "maxpage", "freepage", "zeropage", "fpath")
+                   "maxpage", "freepage", "zeropage", "fpath", "fuse")
 
     def __init__(self, comm=None):
         self.comm = comm
@@ -175,6 +176,7 @@ class ObjectManager:
         datasets (and P==1) keep the exact single path: our serial tier
         intentionally omits the reference's ``.0`` suffix so script
         goldens address one file."""
+        mr._flush_plan()   # a pending fused plan must land before we read
         if index > len(self.outputs):
             return
         d = self.outputs[index - 1]

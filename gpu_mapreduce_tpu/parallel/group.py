@@ -17,6 +17,7 @@ from jax.sharding import PartitionSpec as P
 
 from jax.sharding import NamedSharding
 
+from ..core.runtime import bump_dispatch
 from .mesh import mesh_axis_size, row_sharding, row_spec
 from .sharded import (ShardedKMV, ShardedKV, SyncStats, _decode_col,
                       round_cap)
@@ -62,6 +63,55 @@ def _convert_phase1_jit(mesh):
     return phase1
 
 
+def grouped_layout(sk, mask, nrows, gcap: int):
+    """Shard-local group layout of SORTED rows → (ukey, sizes, voff,
+    seg, g).  THE one copy of the convert phase-2 math — shared by the
+    eager `_convert_phase2_jit` and the plan/ fuser's fused programs, so
+    fused output can never drift from eager."""
+    cap = sk.shape[0]
+    seg = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    in_group = seg >= 0  # rows before the first boundary are invalid
+    tgt = jnp.where(mask, seg, gcap)
+    # unique keys: first row of each group
+    ushape = (gcap,) + sk.shape[1:]
+    ukey = jnp.zeros(ushape, sk.dtype).at[tgt].set(sk, mode="drop")
+    # group start offsets (shard-local row index)
+    voff = jnp.full(gcap, cap, jnp.int32).at[tgt].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    # per-group sizes: count rows whose running seg == g
+    sizes = jax.ops.segment_sum(
+        jnp.where(in_group, 1, 0).astype(jnp.int32),
+        jnp.where(in_group, seg, gcap), num_segments=gcap + 1)[:gcap]
+    # clamp ON DEVICE: padding rows sorted past the valid count
+    # inherit the last group's seg id — the last group must end
+    # at nrows, groups past the shard's group count zero out (was a
+    # host loop + second round-trip, VERDICT r2 #8)
+    g = jnp.sum(mask.astype(jnp.int32))
+    gi = jnp.arange(gcap)
+    last = jnp.maximum(g - 1, 0)
+    sizes = jnp.where(gi < g, sizes, 0)
+    sizes = jnp.where((gi == last) & (g > 0),
+                      nrows.astype(jnp.int32) - voff[last], sizes)
+    return ukey, sizes.astype(jnp.int32), voff, seg, g
+
+
+def segment_reduce_rows(x, seg, valid, gcap: int, op: str):
+    """One output row per segment (sum/max/min with the kernel tier's
+    fill values) — shared by `_reduce_build` and the fuser."""
+    ids = jnp.where(valid, seg, gcap)
+    vmask = _bmask(valid, x)
+    if op == "sum":
+        return jax.ops.segment_sum(jnp.where(vmask, x, 0), ids,
+                                   num_segments=gcap + 1)[:gcap]
+    if op == "max":
+        return jax.ops.segment_max(jnp.where(vmask, x, _tiny(x.dtype)),
+                                   ids, num_segments=gcap + 1)[:gcap]
+    if op == "min":
+        return jax.ops.segment_min(jnp.where(vmask, x, _huge(x.dtype)),
+                                   ids, num_segments=gcap + 1)[:gcap]
+    raise ValueError(op)
+
+
 @functools.lru_cache(maxsize=None)
 def _convert_phase2_jit(mesh, gcap: int):
     spec = row_spec(mesh)
@@ -69,31 +119,9 @@ def _convert_phase2_jit(mesh, gcap: int):
     @jax.jit
     def phase2(skey, mask, count):
         def body(sk, m, c):
-            cap = sk.shape[0]
-            seg = jnp.cumsum(m.astype(jnp.int32)) - 1
-            in_group = seg >= 0  # rows before the first boundary are invalid
-            tgt = jnp.where(m, seg, gcap)
-            # unique keys: first row of each group
-            ushape = (gcap,) + sk.shape[1:]
-            ukey = jnp.zeros(ushape, sk.dtype).at[tgt].set(sk, mode="drop")
-            # group start offsets (shard-local row index)
-            voff = jnp.full(gcap, cap, jnp.int32).at[tgt].set(
-                jnp.arange(cap, dtype=jnp.int32), mode="drop")
-            # per-group sizes: count rows whose running seg == g
-            sizes = jax.ops.segment_sum(
-                jnp.where(in_group, 1, 0).astype(jnp.int32),
-                jnp.where(in_group, seg, gcap), num_segments=gcap + 1)[:gcap]
-            # clamp ON DEVICE: padding rows sorted past the valid count
-            # inherit the last group's seg id — the last group must end
-            # at c, groups past the shard's group count zero out (was a
-            # host loop + second round-trip, VERDICT r2 #8)
-            g = jnp.sum(m.astype(jnp.int32))
-            gi = jnp.arange(gcap)
-            last = jnp.maximum(g - 1, 0)
-            sizes = jnp.where(gi < g, sizes, 0)
-            sizes = jnp.where((gi == last) & (g > 0),
-                              c[0].astype(jnp.int32) - voff[last], sizes)
-            return ukey, sizes.astype(jnp.int32), voff
+            ukey, sizes, voff, _seg, _g = grouped_layout(sk, m, c[0],
+                                                         gcap)
+            return ukey, sizes, voff
         return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=(spec, spec, spec))(skey, mask,
                                                            count)
@@ -110,12 +138,14 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
     MPI_Allreduce."""
     mesh = skv.mesh
     counts_dev = jax.device_put(skv.counts.astype(np.int32), row_sharding(mesh))
+    bump_dispatch()
     skey, svalue, mask, ucounts = _convert_phase1_jit(mesh)(
         skv.key, skv.value, counts_dev)
     SyncStats.bump()
     gcounts = np.asarray(ucounts).astype(np.int32)
     gcap = round_cap(int(gcounts.max())) if gcounts.max() else 8
 
+    bump_dispatch()
     ukey, nvalues, voffsets = _convert_phase2_jit(mesh, gcap)(
         skey, mask, counts_dev)
     return ShardedKMV(skv.mesh, ukey, nvalues, voffsets, svalue,
@@ -158,21 +188,7 @@ def _reduce_build(mesh, gcap: int, op: str, values_transform):
             seg = _local_segment_ids(vo, nv, vcap)
             valid = jnp.arange(vcap) < vc
             x = vals if values_transform is None else values_transform(vals)
-            if op == "sum":
-                x = jnp.where(_bmask(valid, x), x, 0)
-                out = jax.ops.segment_sum(x, jnp.where(valid, seg, gcap),
-                                          num_segments=gcap + 1)[:gcap]
-            elif op == "max":
-                out = jax.ops.segment_max(
-                    jnp.where(_bmask(valid, x), x, _tiny(x.dtype)),
-                    jnp.where(valid, seg, gcap), num_segments=gcap + 1)[:gcap]
-            elif op == "min":
-                out = jax.ops.segment_min(
-                    jnp.where(_bmask(valid, x), x, _huge(x.dtype)),
-                    jnp.where(valid, seg, gcap), num_segments=gcap + 1)[:gcap]
-            else:
-                raise ValueError(op)
-            return uk, out
+            return uk, segment_reduce_rows(x, seg, valid, gcap, op)
         return jax.shard_map(body, mesh=mesh,
                              in_specs=(spec, spec, spec, spec, spec),
                              out_specs=(spec, spec))(ukey, nval, voff, values,
@@ -194,6 +210,7 @@ def reduce_sharded(kmv: ShardedKMV, op: str = "sum",
     run = _reduce_jit(kmv.mesh, kmv.gcap, op, values_transform)
     vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32),
                                  row_sharding(kmv.mesh))
+    bump_dispatch()
     ukey, out = run(kmv.ukey, kmv.nvalues, kmv.voffsets, kmv.values, vcounts_dev)
     return ShardedKV(kmv.mesh, ukey, out, kmv.gcounts.copy(),
                      key_decode=kmv.key_decode)
@@ -232,6 +249,7 @@ def _first_jit(mesh):
 
 def first_sharded(kmv: ShardedKMV) -> ShardedKV:
     """One output pair per group with the group's FIRST value (dedupe/cull)."""
+    bump_dispatch()
     uk, v = _first_jit(kmv.mesh)(kmv.ukey, kmv.voffsets, kmv.values)
     return ShardedKV(kmv.mesh, uk, v, kmv.gcounts.copy(),
                      key_decode=kmv.key_decode,
@@ -266,6 +284,7 @@ def sort_multivalues_sharded(kmv: ShardedKMV,
     region, so offsets/sizes are unchanged."""
     vcounts_dev = jax.device_put(kmv.vcounts.astype(np.int32),
                                  row_sharding(kmv.mesh))
+    bump_dispatch()
     values = _sortmv_jit(kmv.mesh, descending)(
         kmv.voffsets, kmv.nvalues, kmv.values, vcounts_dev)
     return ShardedKMV(kmv.mesh, kmv.ukey, kmv.nvalues, kmv.voffsets, values,
@@ -311,6 +330,7 @@ def sort_sharded(skv: ShardedKV, by: str = "key",
                  descending: bool = False) -> ShardedKV:
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(skv.mesh))
+    bump_dispatch()
     k, v = _sort_jit(skv.mesh, by, descending)(skv.key, skv.value, counts_dev)
     return ShardedKV(skv.mesh, k, v, skv.counts.copy(),
                      key_decode=skv.key_decode,
@@ -381,6 +401,7 @@ def sort_interned_sharded(skv: ShardedKV, by: str = "key",
         table._rank_cache = (len(table), ids_by_id, rank_of)
     rep = NamedSharding(skv.mesh, P())
     nrows = skv.key.shape[0]
+    bump_dispatch()
     k, v = _sort_interned_jit(skv.mesh, nrows, by, descending)(
         skv.key, skv.value, jnp.asarray(skv.counts.astype(np.int32)),
         jax.device_put(ids_by_id, rep), jax.device_put(rank_of, rep))

@@ -27,6 +27,8 @@ multi-round fixed-budget variant can slot in here later (SURVEY.md §7).
 from __future__ import annotations
 
 import functools
+import os
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 import jax
@@ -36,7 +38,9 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.frame import KVFrame
+from ..core.runtime import bump_dispatch
 from ..ops.hash import hash_words32
+from ..plan.cache import LRUCache
 from .mesh import (flat_axis_index, mesh_axes, mesh_axis_size,
                    row_sharding, row_spec)
 from .sharded import ShardedKV, SyncStats, round_cap, shard_frame
@@ -207,18 +211,25 @@ def _dest_fn(dest, nprocs: int, mesh) -> Callable:
     raise ValueError(dest)
 
 
+# bounded executable caches (ISSUE 2 satellite): the pre-plan caches
+# were functools.lru_cache(None) — long soak runs across many meshes /
+# dest functions / cap tuples pinned every executable forever.  Same
+# LRU policy (and telemetry) as the plan cache; stats land in
+# MapReduce.stats()["plan"] via plan.cache.cache_stats().
+PHASE1_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
+                        name="shuffle.phase1")
+PHASE2_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
+                        name="shuffle.phase2")
+
+
 def _phase1_jit(mesh, dest):
     """Cache the jitted phase1 only for stable dest specs — a per-call
-    user hash lambda would defeat reuse AND pin every executable forever
-    in an unbounded cache, so those build uncached (old behavior)."""
+    user hash lambda would defeat reuse (and one-shot entries would
+    churn the LRU), so those build uncached (old behavior)."""
     if dest[0] == "hash" and dest[1] is not None:
         return _phase1_build(mesh, dest)
-    return _phase1_cached(mesh, dest)
-
-
-@functools.lru_cache(maxsize=None)
-def _phase1_cached(mesh, dest):
-    return _phase1_build(mesh, dest)
+    return PHASE1_CACHE.get_or_build(
+        (mesh, dest), lambda: _phase1_build(mesh, dest))
 
 
 def _phase1_build(mesh, dest):
@@ -236,42 +247,59 @@ def _phase1_build(mesh, dest):
     return phase1
 
 
-@functools.lru_cache(maxsize=None)
-def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
-    """Multi-round bounded exchange: each round moves ≤ B rows per
-    (src, dest) bucket, so the padded send buffer is [P, B] regardless of
-    skew — the TPU equivalent of the reference's fraction<1.0
+def phase2_shard_body(nprocs: int, transport: int, mesh, B: int,
+                      nrounds: int, cap_out: int, k, v, cl):
+    """Per-shard phase-2 body — the fusible stage builder the plan/
+    fuser composes with convert/reduce inside ONE shard_map program.
+    Returns ``(out_k, out_v, nrecv)``: received rows packed to the
+    front of a [cap_out, ...] block plus this shard's valid-row count.
+
+    Multi-round bounded exchange: each round moves ≤ B rows per
+    (src, dest) bucket, so the padded send buffer is [P, B] regardless
+    of skew — the TPU equivalent of the reference's fraction<1.0
     flow-control retry loop (src/mapreduce.cpp:498-513,
     irregular.cpp:95-242), but with statically known round count.
     Received rows scatter directly to their final packed position
     (base[src] + round*B + slot), so no per-round compaction pass."""
+    counts_from = _exchange_counts(cl, transport, mesh)
+    cum = jnp.cumsum(counts_from)
+    base = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), cum[:-1].astype(jnp.int32)])
+    out_k = jnp.zeros((cap_out,) + k.shape[1:], k.dtype)
+    out_v = jnp.zeros((cap_out,) + v.shape[1:], v.dtype)
+    slot = jnp.arange(B, dtype=jnp.int32)
+    for r in range(nrounds):
+        recv_k = _exchange_blocks(
+            _build_send(nprocs, B, k, cl, r), transport, mesh)
+        recv_v = _exchange_blocks(
+            _build_send(nprocs, B, v, cl, r), transport, mesh)
+        # position of recv[j, q]: base[j] + r*B + q; invalid slots
+        # (past counts_from[j]) push out of range and drop
+        q_global = r * B + slot[None, :]
+        pos = jnp.where(q_global < counts_from[:, None],
+                        base[:, None] + q_global, cap_out)
+        out_k = out_k.at[pos.reshape(-1)].set(
+            recv_k.reshape((-1,) + k.shape[1:]), mode="drop")
+        out_v = out_v.at[pos.reshape(-1)].set(
+            recv_v.reshape((-1,) + v.shape[1:]), mode="drop")
+    return out_k, out_v, jnp.sum(counts_from)
+
+
+def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
+    return PHASE2_CACHE.get_or_build(
+        (mesh, transport, B, nrounds, cap_out),
+        lambda: _phase2_build(mesh, transport, B, nrounds, cap_out))
+
+
+def _phase2_build(mesh, transport: int, B: int, nrounds: int, cap_out: int):
     nprocs = mesh_axis_size(mesh)
     spec = row_spec(mesh)
 
     @jax.jit
     def phase2(skey, svalue, counts_local):
         def body(k, v, cl):
-            counts_from = _exchange_counts(cl, transport, mesh)
-            cum = jnp.cumsum(counts_from)
-            base = jnp.concatenate(
-                [jnp.zeros(1, jnp.int32), cum[:-1].astype(jnp.int32)])
-            out_k = jnp.zeros((cap_out,) + k.shape[1:], k.dtype)
-            out_v = jnp.zeros((cap_out,) + v.shape[1:], v.dtype)
-            slot = jnp.arange(B, dtype=jnp.int32)
-            for r in range(nrounds):
-                recv_k = _exchange_blocks(
-                    _build_send(nprocs, B, k, cl, r), transport, mesh)
-                recv_v = _exchange_blocks(
-                    _build_send(nprocs, B, v, cl, r), transport, mesh)
-                # position of recv[j, q]: base[j] + r*B + q; invalid slots
-                # (past counts_from[j]) push out of range and drop
-                q_global = r * B + slot[None, :]
-                pos = jnp.where(q_global < counts_from[:, None],
-                                base[:, None] + q_global, cap_out)
-                out_k = out_k.at[pos.reshape(-1)].set(
-                    recv_k.reshape((-1,) + k.shape[1:]), mode="drop")
-                out_v = out_v.at[pos.reshape(-1)].set(
-                    recv_v.reshape((-1,) + v.shape[1:]), mode="drop")
+            out_k, out_v, _ = phase2_shard_body(
+                nprocs, transport, mesh, B, nrounds, cap_out, k, v, cl)
             return out_k, out_v
         return jax.shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec),
@@ -334,13 +362,53 @@ class _ExchangeStatsMeta(type):
         super().__setattr__(name, value)
 
 
+@dataclass
+class ExchangeCallStats:
+    """Flow-control telemetry of ONE exchange() call (ISSUE 2
+    satellite): the class-level ExchangeStats records only the LAST
+    exchange process-wide, so two concurrent MapReduce objects
+    (mapstyle-2 threads, -partition worlds, fused plans running
+    interleaved segments) silently clobber each other.  This per-call
+    object is attached to the returned ShardedKV (``.exchange_stats``)
+    and surfaced as ``MapReduce.last_exchange`` after aggregate(); the
+    same numbers land on the obs ``shuffle.exchange`` span."""
+
+    nrounds: int
+    bucket: int
+    cap_out: int
+    rows: int                 # total rows routed (count-matrix sum)
+    speculative: bool         # phase 2 ran on cached caps
+    sent_bytes: int = 0
+    pad_bytes: int = 0
+
+
+def exchange_volume(skv: ShardedKV, counts_mat, B: int, nrounds: int,
+                    nprocs: int) -> tuple:
+    """(moved, pad, rowbytes) of one exchange — shared by the eager
+    exchange and the plan/ fuser so their telemetry can never diverge.
+    Padding diagnosis (VERDICT r2 #5): the exchange physically moves
+    nrounds × [P,B] buckets per shard; the slack beyond the real rows is
+    pure padding volume.  Diagonal (self→self) slots never cross the
+    interconnect — excluded on BOTH sides so pad is directly comparable
+    to cssize."""
+    rowbytes = (skv.key.dtype.itemsize
+                * (skv.key.shape[-1] if skv.key.ndim > 1 else 1)
+                + skv.value.dtype.itemsize
+                * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
+    useful = int(counts_mat.sum() - np.trace(counts_mat))
+    moved = useful * rowbytes
+    sent_slots = nprocs * (nprocs - 1) * B * nrounds
+    pad = max(0, sent_slots - useful) * rowbytes
+    return moved, pad, rowbytes
+
+
 class ExchangeStats(metaclass=_ExchangeStatsMeta):
-    """Telemetry of the LAST exchange's flow control (class attrs, like
-    sharded.ToHostStats): the multi-round path is invisible from the
-    outside — results are identical either way — so the driver dryrun
-    and tests assert on these to prove skew actually engaged it
-    (VERDICT r3 #5).  ``last`` is ONE (nrounds, bucket) tuple so a
-    reader under -partition threading never sees a torn pair; the
+    """DEPRECATED process-global telemetry of the LAST exchange's flow
+    control — kept as a read-only shim for existing callers; new code
+    reads the per-call :class:`ExchangeCallStats` on the exchange
+    result (or ``mr.last_exchange``), which concurrent MapReduce
+    objects cannot clobber.  ``last`` is ONE (nrounds, bucket) tuple so
+    a reader under -partition threading never sees a torn pair; the
     legacy attribute names read through it."""
     last = (0, 0)
 
@@ -381,6 +449,7 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
 
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
+    bump_dispatch()
     skey, svalue, counts_local = _phase1_jit(mesh, dest)(
         skv.key, skv.value, counts_dev)
     # speculative phase 2: enqueue with last time's caps BEFORE the
@@ -396,6 +465,7 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
         spec = _SPEC_CACHE.get(spec_key)
     out_spec = None
     if spec is not None:
+        bump_dispatch()
         out_spec = _phase2_jit(mesh, transport, *spec)(
             skey, svalue, counts_local)
     SyncStats.bump()   # the op's ONE round-trip: the count matrix
@@ -427,6 +497,7 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
         B, nrounds, cap_out = spec
     else:
         sp.set(speculative=False)
+        bump_dispatch()
         out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out)(
             skey, svalue, counts_local)
         with _SPEC_LOCK:
@@ -434,27 +505,26 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
 
     # one tuple assignment: a concurrent world's exchange can interleave
     # here, but a reader then sees ONE exchange's (nrounds, bucket) pair,
-    # never a torn mix (VERDICT r4 weak #7)
+    # never a torn mix (VERDICT r4 weak #7) — deprecated shim; the
+    # per-call truth is the ExchangeCallStats built below
     ExchangeStats.last = (nrounds, B)
+    stats = ExchangeCallStats(nrounds=nrounds, bucket=B, cap_out=cap_out,
+                              rows=int(counts_mat.sum()),
+                              speculative=out_spec is not None
+                              and (out_k is out_spec[0]))
     sp.set(bucket=B, nrounds=nrounds, cap_out=cap_out,
-           rows=int(counts_mat.sum()))
+           rows=stats.rows)
     if counters is not None:
-        rowbytes = (skv.key.dtype.itemsize * (skv.key.shape[-1] if skv.key.ndim > 1 else 1) +
-                    skv.value.dtype.itemsize * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
-        useful = int(counts_mat.sum() - np.trace(counts_mat))
-        moved = useful * rowbytes
-        # padding diagnosis (VERDICT r2 #5): the exchange physically
-        # moves nrounds × [P,B] buckets per shard; the slack beyond the
-        # real rows is pure padding volume.  Diagonal (self→self) slots
-        # never cross the interconnect — excluded on BOTH sides so pad
-        # is directly comparable to cssize
-        sent_slots = nprocs * (nprocs - 1) * B * nrounds
-        pad = max(0, sent_slots - useful) * rowbytes
+        moved, pad, rowbytes = exchange_volume(skv, counts_mat, B,
+                                               nrounds, nprocs)
         counters.add(cssize=moved, crsize=moved, cspad=pad)
         sp.set(sent_bytes=moved, pad_bytes=pad, rowbytes=rowbytes)
-    return ShardedKV(mesh, out_k, out_v, new_counts,
-                     key_decode=skv.key_decode,
-                     value_decode=skv.value_decode)
+        stats.sent_bytes, stats.pad_bytes = moved, pad
+    out = ShardedKV(mesh, out_k, out_v, new_counts,
+                    key_decode=skv.key_decode,
+                    value_decode=skv.value_decode)
+    out.exchange_stats = stats   # per-call telemetry rides the result
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -502,6 +572,9 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     out = exchange(skv, ("hash", hash_fn), transport=mr.settings.all2all,
                    counters=mr.counters)
     mr.counters.add(commtime=t.elapsed())
+    # per-call stats (not the deprecated class attrs): concurrent MRs
+    # each keep their own last_exchange
+    mr.last_exchange = getattr(out, "exchange_stats", None)
     _replace_kv_frames(kv, out)
 
 

@@ -1,0 +1,31 @@
+"""plan/ — lazy pipeline planner with fused compiled execution.
+
+Every MapReduce op is eager by default: ``map``, ``aggregate``,
+``convert``, ``reduce`` each dispatch their own jitted program(s),
+materialize an intermediate dataset and sync with the host between ops.
+This subsystem defers op chains into a small IR (:mod:`.ir`), fuses
+maximal device-tier runs into single ``jit``/``shard_map`` programs
+(:mod:`.fuser`) and caches compiled plans across runs (:mod:`.cache`):
+
+    with mr.pipeline():          # or MapReduce(fuse=1) / MRTPU_FUSE=1
+        mr.aggregate()
+        mr.convert()
+        mr.reduce(count, batch=True)
+    # ← one phase-1 dispatch + ONE fused exchange/group/reduce program
+
+Host-callback stages, spill boundaries, serial backends and
+gather/print-style barriers break fusion — those segments run the
+ordinary eager path, so every pipeline still runs, fused or not.  See
+``doc/plan.md`` for the fusion-break rules and the cache key.
+"""
+
+from .cache import (LRUCache, cache_stats, clear_history, plan_cache,
+                    plan_history)
+from .ir import Plan, PlanStage
+from .recorder import PendingCount, PlanRecorder
+
+__all__ = [
+    "Plan", "PlanStage", "PlanRecorder", "PendingCount",
+    "LRUCache", "plan_cache", "cache_stats", "plan_history",
+    "clear_history",
+]

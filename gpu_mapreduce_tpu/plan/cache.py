@@ -1,0 +1,143 @@
+"""Bounded caches for compiled artifacts + the plan cache.
+
+Two problems, one mechanism:
+
+* the shuffle/convert jit builders were ``functools.lru_cache(None)`` —
+  unbounded, so long soak runs over many meshes/dest functions pin every
+  executable forever (ISSUE 2 satellite);
+* the plan fuser compiles whole pipelines and must reuse them across
+  runs, with visible hit/miss/eviction telemetry (the production
+  inference-stack shape: a compiled-plan cache keyed on program
+  fingerprint + shapes).
+
+:class:`LRUCache` is the shared policy: thread-safe (``-partition``
+worlds record/execute plans from interpreter threads), move-to-back on
+hit, evict-front past ``maxsize``, with cumulative hit/miss/eviction
+counters that ``MapReduce.stats()`` and the obs spans report.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+
+class LRUCache:
+    """Thread-safe LRU with telemetry.  ``get_or_build(key, build)`` is
+    the only way entries appear; ``build()`` runs OUTSIDE the lock (it
+    may trace/compile for seconds) — a racing builder for the same key
+    wastes one build but never deadlocks or tears the dict."""
+
+    def __init__(self, maxsize: int, name: str = "cache"):
+        self.name = name
+        self.maxsize = max(1, int(maxsize))
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key):
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self.hits += 1
+                return self._d[key]
+            self.misses += 1
+            return None
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+                self.evictions += 1
+
+    def get_or_build(self, key, build: Callable):
+        hit = self.get(key)
+        if hit is not None:
+            return hit
+        value = build()
+        self.put(key, value)
+        return value
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def resize(self, maxsize: int) -> None:
+        with self._lock:
+            self.maxsize = max(1, int(maxsize))
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._d), "maxsize": self.maxsize,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+# ---------------------------------------------------------------------------
+# the plan cache: (stage-chain fingerprint, frame shapes/dtypes, mesh,
+# transport) → executable plan (see fuser.CompiledPlan)
+# ---------------------------------------------------------------------------
+
+_PLAN_CACHE: Optional[LRUCache] = None
+_PLAN_LOCK = threading.Lock()
+
+
+def plan_cache() -> LRUCache:
+    global _PLAN_CACHE
+    if _PLAN_CACHE is None:
+        with _PLAN_LOCK:
+            if _PLAN_CACHE is None:
+                _PLAN_CACHE = LRUCache(
+                    int(os.environ.get("MRTPU_PLAN_CACHE", 32)),
+                    name="plan")
+    return _PLAN_CACHE
+
+
+def cache_stats() -> dict:
+    """Structured snapshot of every bounded compile cache — the plan
+    cache plus the shuffle's phase1/phase2 jit caches (what
+    ``MapReduce.stats()['plan']`` reports)."""
+    out = {"plan": plan_cache().stats()}
+    from ..parallel import shuffle
+    out["shuffle_phase1"] = shuffle.PHASE1_CACHE.stats()
+    out["shuffle_phase2"] = shuffle.PHASE2_CACHE.stats()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# plan history: the last few executed plans, described, for dump_plan /
+# scripts/plan_dump.py (the trace ring's analog for whole plans)
+# ---------------------------------------------------------------------------
+
+_HISTORY: list = []
+_HISTORY_LOCK = threading.Lock()
+_HISTORY_CAP = 64
+
+
+def record_history(desc: dict) -> None:
+    with _HISTORY_LOCK:
+        _HISTORY.append(desc)
+        del _HISTORY[:-_HISTORY_CAP]
+
+
+def plan_history() -> list:
+    with _HISTORY_LOCK:
+        return list(_HISTORY)
+
+
+def clear_history() -> None:
+    with _HISTORY_LOCK:
+        _HISTORY.clear()

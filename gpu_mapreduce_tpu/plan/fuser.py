@@ -1,0 +1,543 @@
+"""The fuser: compile a recorded stage chain into fused device programs.
+
+Walks the plan front-to-back against the LIVE dataset/backend state and
+greedily groups maximal fusible runs:
+
+* ``[aggregate, convert, reduce(kernel, batch)]`` on a multi-shard mesh
+  → TWO compiled programs: the shuffle's jitted phase 1 (hash + sort by
+  dest + counts), then ONE ``jit``/``shard_map`` program that composes
+  the phase-2 exchange (``shuffle.phase2_shard_body``), the local
+  convert (sort + boundary detection, the ``parallel/group`` bodies)
+  and the segment reduce — where the eager path dispatches ~5 programs
+  with a host sync between every op.
+* ``[aggregate, convert]`` (collate feeding a host-callback reduce)
+  → the same two programs, producing a grouped ShardedKMV.
+* ``[convert, reduce(kernel, batch)]`` on an already-sharded KV
+  → ONE fused local program (no exchange).
+
+Everything else — host-callback tiers, serial backend, spill/out-of-core
+datasets, over-HBM-budget datasets, comparator sorts — **breaks fusion**:
+those stages replay through the ordinary eager methods, so every
+pipeline still runs, fused or not.
+
+Compiled plans live in the plan cache (``plan.cache``) keyed on
+(stage-chain fingerprint, frame shapes/dtypes, mesh, transport); a hit
+reuses the previous run's exchange caps (validated against the fresh
+count matrix, like the shuffle's speculative-cap cache) so repeated
+pipelines reuse compiled programs instead of re-deriving shapes.
+Telemetry: ``plan.execute`` / ``plan.group`` obs spans with
+``cache_hit``/``fused`` attrs, plan-cache hit/miss/eviction counters in
+``MapReduce.stats()["plan"]``, and every program launch counted in
+``Counters.ndispatch``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field as _field
+from typing import Optional
+
+import numpy as np
+
+from .cache import LRUCache, plan_cache, record_history
+from .ir import Plan, PlanStage, frame_signature
+
+# bounded builder cache for the fused jitted programs (same policy as
+# the shuffle's phase caches)
+FUSED_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
+                       name="plan.fused")
+
+
+@dataclass
+class CompiledPlan:
+    """Cached executable state of one (fingerprint, shapes) plan: the
+    group structure last used plus per-group exchange caps for reuse."""
+    groups: list = _field(default_factory=list)   # descriptions (history)
+    caps: dict = _field(default_factory=dict)     # group idx → (B, R, cap)
+    runs: int = 0
+
+
+# ---------------------------------------------------------------------------
+# stage classification helpers
+# ---------------------------------------------------------------------------
+
+def _kernel_op(fn) -> Optional[str]:
+    """Registered kernel reduce → segment-op name (None = host tier)."""
+    from ..ops import reduces
+    table = {reduces.count: "count", reduces.sum_values: "sum",
+             reduces.max_values: "max", reduces.min_values: "min",
+             reduces.cull: "first"}
+    return table.get(fn)
+
+
+def _reduce_stage_op(st: PlanStage) -> Optional[str]:
+    """Fusible reduce stage → segment-op name, else None."""
+    if st.op != "reduce" or not st.args:
+        return None
+    if not (st.kw.get("batch") or (len(st.args) > 2 and st.args[2])):
+        return None
+    if st.kw.get("block_rows") is not None:
+        return None
+    return _kernel_op(st.args[0])
+
+
+def _agg_hash(st: PlanStage):
+    """(ok, hash_fn) for an aggregate stage: host-evaluated hashes break
+    fusion (they need per-key python on the controller)."""
+    fn = st.args[0] if st.args else st.kw.get("hash_fn")
+    if fn is not None and getattr(fn, "host_hash", False):
+        return False, fn
+    return True, fn
+
+
+def _device_state(mr):
+    """The live frame a fused group would consume, or None when the
+    current state is not device-fusible (spill, budget, serial, host
+    tiers) — the fusion-break rules of doc/plan.md."""
+    from ..parallel.backend import MeshBackend
+    if not isinstance(mr.backend, MeshBackend):
+        return None
+    kv = mr.kv
+    if kv is None or not kv.complete_done or mr._open:
+        return None
+    if mr.settings.outofcore == 1:          # spill boundary
+        return None
+    if not kv.is_host_dataset() and mr._mesh_over_budget(kv):
+        return None                          # HBM budget → external path
+    frame = kv.one_frame()
+    if len(frame) == 0:
+        return None                          # eager handles empties
+    return frame
+
+
+def _match_group(mr, stages, i):
+    """(n_stages, kind, reduce_op, frame) of the fused group starting at
+    stage i against the live state, or (1, None, None, None) → eager
+    replay.  The materialized frame rides along so the exec functions
+    don't pay ``one_frame()`` (a device concat on multi-frame datasets)
+    a second time."""
+    from ..core.frame import KVFrame
+    from ..parallel.sharded import ShardedKV
+    st = stages[i]
+    n = len(stages)
+    if st.op == "aggregate":
+        ok, _fn = _agg_hash(st)
+        frame = _device_state(mr) if ok else None
+        if (frame is not None and mr.backend.nprocs > 1
+                and i + 1 < n and stages[i + 1].op == "convert"
+                and (isinstance(frame, ShardedKV)
+                     or (isinstance(frame, KVFrame) and frame.is_dense())
+                     or _internable(frame))):
+            rop = _reduce_stage_op(stages[i + 2]) if i + 2 < n else None
+            if rop is not None and not _reduce_value_ok(frame, rop):
+                rop = None
+            if rop is not None:
+                return 3, "exchange", rop, frame
+            return 2, "exchange", None, frame
+        return 1, None, None, None
+    if st.op == "convert":
+        frame = _device_state(mr)
+        if isinstance(frame, ShardedKV) and i + 1 < n:
+            rop = _reduce_stage_op(stages[i + 1])
+            if rop is not None and _reduce_value_ok(frame, rop):
+                return 2, "local", rop, frame
+        return 1, None, None, None
+    return 1, None, None, None
+
+
+def _internable(frame) -> bool:
+    from ..core.column import BytesColumn, DenseColumn, ObjectColumn
+    return all(isinstance(c, (BytesColumn, DenseColumn, ObjectColumn))
+               for c in (frame.key, frame.value))
+
+
+def _reduce_value_ok(frame, rop: str) -> bool:
+    """Arithmetic on interned byte/object VALUE ids is meaningless —
+    eager reduce_sharded raises for it; fall back so the same error
+    surfaces from the same code path."""
+    if rop in ("count", "first"):
+        return True
+    from ..core.column import BytesColumn, ObjectColumn
+    if getattr(frame, "value_decode", None) is not None:
+        return False
+    value = getattr(frame, "value", None)
+    return not isinstance(value, (BytesColumn, ObjectColumn))
+
+
+# ---------------------------------------------------------------------------
+# fused program bodies (composable, shard-local)
+# ---------------------------------------------------------------------------
+
+def _group_reduce_body(k, v, nrecv, gcap: int, out_kind: str,
+                       reduce_op: Optional[str]):
+    """Shard-local convert(+reduce) over packed valid rows: sort by key,
+    boundary-detect groups, then either emit the grouped layout
+    (out_kind='kmv') or segment-reduce to one pair per group
+    (out_kind='kv').  Composes the SAME shard-local bodies the eager
+    tier jits — `parallel/group`'s `_local_sort`/`_boundary`/
+    `grouped_layout`/`segment_reduce_rows` — so fused output is
+    byte-identical to the eager path by construction."""
+    import jax.numpy as jnp
+    from ..parallel.group import (_boundary, _local_sort, grouped_layout,
+                                  segment_reduce_rows)
+
+    sk, sv, valid = _local_sort(k, v, nrecv)
+    mask = _boundary(sk, valid)
+    ukey, sizes, voff, seg, g = grouped_layout(sk, mask, nrecv, gcap)
+    meta = jnp.stack([g, nrecv.astype(jnp.int32)])
+    if out_kind == "kmv":
+        return ukey, sizes, voff, sv, meta
+    if reduce_op == "count":
+        return ukey, sizes.astype(jnp.int64), meta
+    if reduce_op == "first":
+        uval = jnp.zeros((gcap,) + sv.shape[1:], sv.dtype).at[
+            jnp.where(mask, seg, gcap)].set(sv, mode="drop")
+        return ukey, uval, meta
+    return ukey, segment_reduce_rows(sv, seg, valid, gcap, reduce_op), meta
+
+
+def _fused_exchange_jit(mesh, transport: int, B: int, nrounds: int,
+                        cap_out: int, out_kind: str,
+                        reduce_op: Optional[str]):
+    key = ("exchange", mesh, transport, B, nrounds, cap_out, out_kind,
+           reduce_op)
+    return FUSED_CACHE.get_or_build(
+        key, lambda: _fused_exchange_build(mesh, transport, B, nrounds,
+                                           cap_out, out_kind, reduce_op))
+
+
+def _fused_exchange_build(mesh, transport, B, nrounds, cap_out, out_kind,
+                          reduce_op):
+    import jax
+    from ..parallel.mesh import mesh_axis_size, row_spec
+    from ..parallel.shuffle import phase2_shard_body
+    nprocs = mesh_axis_size(mesh)
+    spec = row_spec(mesh)
+    nouts = 5 if out_kind == "kmv" else 3
+
+    @jax.jit
+    def run(skey, svalue, counts_local):
+        def body(k, v, cl):
+            out_k, out_v, nrecv = phase2_shard_body(
+                nprocs, transport, mesh, B, nrounds, cap_out, k, v, cl)
+            return _group_reduce_body(out_k, out_v, nrecv, cap_out,
+                                      out_kind, reduce_op)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec,) * nouts)(skey, svalue, counts_local)
+
+    return run
+
+
+def _compact_jit(mesh, n: int, narrs: int):
+    """Per-shard leading-rows slice: shrink a fused group's [cap_out]
+    outputs to the eager tier's round_cap(max groups) residency.  One
+    cheap extra dispatch, paid only when it shrinks ≥4× (see
+    _maybe_compact) — without it duplicate-heavy keys leave the resident
+    dataset (and every downstream compile) sized at row capacity."""
+    key = ("compact", mesh, n, narrs)
+
+    def build():
+        import jax
+        from ..parallel.mesh import row_spec
+        spec = row_spec(mesh)
+
+        @jax.jit
+        def run(*arrs):
+            body = lambda *xs: tuple(x[:n] for x in xs)
+            return jax.shard_map(body, mesh=mesh, in_specs=(spec,) * narrs,
+                                 out_specs=(spec,) * narrs)(*arrs)
+        return run
+    return FUSED_CACHE.get_or_build(key, build)
+
+
+def _maybe_compact(mesh, gcap: int, gcounts, *arrs):
+    """Slice group-indexed outputs down to round_cap(max group count)
+    when that shrinks ≥4×; otherwise return them unchanged (the extra
+    dispatch isn't worth single-digit savings)."""
+    from ..core.runtime import bump_dispatch
+    from ..parallel.sharded import round_cap
+    new_gcap = round_cap(max(int(gcounts.max()), 1))
+    if new_gcap * 4 > gcap:
+        return arrs
+    bump_dispatch()
+    return _compact_jit(mesh, new_gcap, len(arrs))(*arrs)
+
+
+def _fused_local_jit(mesh, out_kind: str, reduce_op: Optional[str]):
+    key = ("local", mesh, out_kind, reduce_op)
+    return FUSED_CACHE.get_or_build(
+        key, lambda: _fused_local_build(mesh, out_kind, reduce_op))
+
+
+def _fused_local_build(mesh, out_kind, reduce_op):
+    import jax
+    from ..parallel.mesh import row_spec
+    spec = row_spec(mesh)
+    nouts = 5 if out_kind == "kmv" else 3
+
+    @jax.jit
+    def run(key, value, counts):
+        def body(k, v, c):
+            return _group_reduce_body(k, v, c[0], k.shape[0], out_kind,
+                                      reduce_op)
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec,) * nouts)(key, value, counts)
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# fused group execution
+# ---------------------------------------------------------------------------
+
+def _as_sharded(mr, frame):
+    """Host frame → ShardedKV (intern byte/object columns + block-shard),
+    exactly the eager aggregate's preparation (shuffle.aggregate_kv)."""
+    from ..core.frame import KVFrame
+    from ..parallel.sharded import shard_frame
+    from ..parallel.shuffle import _intern_frame
+    if not isinstance(frame, KVFrame):
+        return frame
+    frame, ktable, vtable = _intern_frame(frame, mr.backend.nprocs)
+    skv = shard_frame(frame, mr.backend.mesh)
+    skv.key_decode = ktable
+    skv.value_decode = vtable
+    return skv
+
+
+def _install_kv(mr, skv):
+    """Replace mr's dataset with a fused group's ShardedKV output."""
+    if mr.kmv is not None:
+        mr.kmv.free()
+        mr.kmv = None
+    old = mr.kv
+    newkv = mr._new_kv()
+    newkv.add_frame(skv)
+    newkv.complete()
+    if old is not None:
+        old.free()
+    mr.kv = newkv
+
+
+def _install_kmv(mr, skmv):
+    if mr.kv is not None:
+        mr.kv.free()
+        mr.kv = None
+    mr.kmv = mr._new_kmv()
+    mr.kmv.push(skmv)
+    mr.kmv.complete()
+
+
+def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
+                         gidx: int, sp, frame):
+    """Run [aggregate, convert(, reduce)] as phase1 + ONE fused program."""
+    import jax
+    from ..core.runtime import Timer, bump_dispatch
+    from ..parallel.mesh import mesh_axis_size, row_sharding
+    from ..parallel.sharded import (ShardedKMV, ShardedKV, SyncStats,
+                                    round_cap)
+    from ..parallel.shuffle import (ExchangeCallStats, ExchangeStats,
+                                    _phase1_jit, _plan_caps)
+
+    mesh = mr.backend.mesh
+    nprocs = mesh_axis_size(mesh)
+    transport = mr.settings.all2all
+    out_kind = "kv" if reduce_op is not None else "kmv"
+    _ok, hash_fn = _agg_hash(stages[0])
+    dest = ("hash", hash_fn)
+
+    skv = _as_sharded(mr, frame)
+    counts_dev = jax.device_put(skv.counts.astype(np.int32),
+                                row_sharding(mesh))
+    t = Timer()
+    bump_dispatch()
+    skey, svalue, counts_local = _phase1_jit(mesh, dest)(
+        skv.key, skv.value, counts_dev)
+    SyncStats.bump()   # the op's ONE round-trip: the count matrix
+    counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
+    B, nrounds, cap_out, Bmax, new_counts = _plan_caps(counts_mat)
+    nmax_out = max(int(new_counts.max()), 8)
+    cached_caps = compiled.caps.get(gidx)
+    if cached_caps is not None and Bmax <= cached_caps[0] * cached_caps[1] \
+            and nmax_out <= cached_caps[2] \
+            and cached_caps[0] * cached_caps[1] <= 4 * max(Bmax, 8) \
+            and cached_caps[2] <= 4 * round_cap(nmax_out):
+        # cached caps still hold every row and aren't grossly oversized:
+        # reuse the compiled program
+        B, nrounds, cap_out = cached_caps
+    else:
+        # too small OR ≥4× too large (skewed first run followed by
+        # uniform data would pay the padded transfer forever, like the
+        # eager speculative cache's right-sizing): recompile at fresh caps
+        compiled.caps[gidx] = (B, nrounds, cap_out)
+    bump_dispatch()
+    out = _fused_exchange_jit(mesh, transport, B, nrounds, cap_out,
+                              out_kind, reduce_op)(skey, svalue,
+                                                   counts_local)
+    meta = np.asarray(out[-1]).reshape(nprocs, 2)
+    gcounts = meta[:, 0].astype(np.int32)
+    vcounts = meta[:, 1].astype(np.int32)
+    mr.counters.add(commtime=t.elapsed())
+    nrows = int(counts_mat.sum())
+    ngroups = int(gcounts.sum())
+    # exchange byte accounting + per-call stats, like the eager exchange
+    stats = ExchangeCallStats(nrounds=nrounds, bucket=B, cap_out=cap_out,
+                              rows=nrows, speculative=False)
+    _account_exchange(mr, skv, counts_mat, B, nrounds, nprocs, stats)
+    ExchangeStats.last = (nrounds, B)   # deprecated shim
+    mr.last_exchange = stats
+    sp.set(bucket=B, nrounds=nrounds, cap_out=cap_out, rows=nrows,
+           groups=ngroups)
+    stages[0].result = nrows
+    stages[1].result = ngroups
+    if out_kind == "kv":
+        ukey, uval, _meta = out
+        ukey, uval = _maybe_compact(mesh, cap_out, gcounts, ukey, uval)
+        skv_out = ShardedKV(mesh, ukey, uval, gcounts,
+                            key_decode=skv.key_decode)
+        if reduce_op == "first":
+            skv_out.value_decode = skv.value_decode
+        _install_kv(mr, skv_out)
+        stages[2].result = ngroups
+    else:
+        # values/voff stay row-capacity-sized (voff indexes value rows,
+        # exactly like the eager ShardedKMV); only group-indexed arrays
+        # compact
+        ukey, sizes, voff, values, _meta = out
+        ukey, sizes, voff = _maybe_compact(mesh, cap_out, gcounts,
+                                           ukey, sizes, voff)
+        skmv = ShardedKMV(mesh, ukey, sizes, voff, values, gcounts,
+                          vcounts, key_decode=skv.key_decode,
+                          value_decode=skv.value_decode)
+        _install_kmv(mr, skmv)
+
+
+def _account_exchange(mr, skv, counts_mat, B, nrounds, nprocs, stats):
+    from ..parallel.shuffle import exchange_volume
+    moved, pad, _rowbytes = exchange_volume(skv, counts_mat, B, nrounds,
+                                            nprocs)
+    mr.counters.add(cssize=moved, crsize=moved, cspad=pad)
+    stats.sent_bytes, stats.pad_bytes = moved, pad
+
+
+def _exec_local_group(mr, stages, reduce_op, sp, frame):
+    """Run [convert, reduce(kernel)] on a ShardedKV as ONE program."""
+    import jax
+    from ..core.runtime import bump_dispatch
+    from ..parallel.mesh import mesh_axis_size, row_sharding
+    from ..parallel.sharded import ShardedKV, SyncStats
+
+    skv = frame
+    mesh = skv.mesh
+    nprocs = mesh_axis_size(mesh)
+    counts_dev = jax.device_put(skv.counts.astype(np.int32),
+                                row_sharding(mesh))
+    bump_dispatch()
+    ukey, uval, meta = _fused_local_jit(mesh, "kv", reduce_op)(
+        skv.key, skv.value, counts_dev)
+    SyncStats.bump()
+    gcounts = np.asarray(meta).reshape(nprocs, 2)[:, 0].astype(np.int32)
+    ngroups = int(gcounts.sum())
+    ukey, uval = _maybe_compact(mesh, skv.key.shape[0] // nprocs,
+                                gcounts, ukey, uval)
+    skv_out = ShardedKV(mesh, ukey, uval, gcounts,
+                        key_decode=skv.key_decode)
+    if reduce_op == "first":
+        skv_out.value_decode = skv.value_decode
+    _install_kv(mr, skv_out)
+    sp.set(groups=ngroups)
+    stages[0].result = ngroups
+    stages[1].result = ngroups
+
+
+def _replay(mr, stage: PlanStage):
+    """Eager fallback: run one recorded stage through the ordinary op
+    method (tracing, stats, tier notes all behave as if never deferred),
+    under the settings snapshot taken at record time."""
+    saved = mr.settings
+    if stage.settings is not None:
+        mr.settings = stage.settings
+    mr._plan_replaying = True
+    try:
+        stage.result = getattr(mr, stage.op)(*stage.args, **stage.kw)
+    finally:
+        mr._plan_replaying = False
+        mr.settings = saved
+
+
+# ---------------------------------------------------------------------------
+# plan execution
+# ---------------------------------------------------------------------------
+
+def execute_plan(mr, plan: Plan) -> None:
+    """Fuse + run a recorded plan against mr's current dataset."""
+    tracer = mr.tracer
+    key = None
+    frame = None
+    kv = mr.kv
+    if kv is not None and kv.complete_done and kv._frames:
+        frame = kv._frames[0]
+    try:
+        key = (plan.fingerprint(), frame_signature(frame),
+               _backend_signature(mr), mr.settings.all2all,
+               mr.settings.outofcore)
+        compiled = plan_cache().get(key)
+    except TypeError:       # unhashable stage arg: run uncached
+        key = None
+        compiled = None
+    cache_hit = compiled is not None
+    if compiled is None:
+        compiled = CompiledPlan()
+        if key is not None:
+            plan_cache().put(key, compiled)
+    compiled.runs += 1
+    groups_desc = []
+    with tracer.span("plan.execute", cat="plan", nstages=len(plan),
+                     cache_hit=cache_hit) as psp:
+        stages = list(plan.stages)
+        i = 0
+        gidx = 0
+        while i < len(stages):
+            n, kind, rop, frame = _match_group(mr, stages, i)
+            run = stages[i:i + n]
+            desc = {"stages": [s.describe() for s in run],
+                    "fused": kind is not None, "kind": kind or "eager",
+                    "reduce_op": rop}
+            groups_desc.append(desc)
+            if kind is None:
+                _replay(mr, run[0])
+            else:
+                with tracer.span("plan.group", cat="plan", kind=kind,
+                                 fused=True, nstages=n,
+                                 reduce_op=rop or "") as sp:
+                    if kind == "exchange":
+                        _exec_exchange_group(mr, run, rop, compiled,
+                                             gidx, sp, frame)
+                    else:
+                        _exec_local_group(mr, run, rop, sp, frame)
+            i += n
+            gidx += 1
+        psp.set(ngroups=gidx,
+                nfused=sum(1 for d in groups_desc if d["fused"]))
+    compiled.groups = groups_desc
+    record_history({"stages": plan.describe(), "groups": groups_desc,
+                    "cache_hit": cache_hit,
+                    "cache_key": _key_brief(key)})
+
+
+def _backend_signature(mr):
+    from ..parallel.backend import MeshBackend
+    if isinstance(mr.backend, MeshBackend):
+        return ("mesh", mr.backend.mesh)
+    return ("serial",)
+
+
+def _key_brief(key) -> Optional[str]:
+    if key is None:
+        return None
+    fp, frame_sig, backend, transport, ooc = key
+    ops = "→".join(s[0] for s in fp)
+    return (f"ops[{ops}] frame{frame_sig!r} backend={backend[0]} "
+            f"all2all={transport} outofcore={ooc}")

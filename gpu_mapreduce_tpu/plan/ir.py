@@ -1,0 +1,95 @@
+"""Plan IR: the deferred-op record a pipeline compiles from.
+
+A :class:`PlanStage` is one deferred MapReduce op call — op name,
+positional/keyword args (callbacks included), and the settings snapshot
+taken at record time (replay runs under the settings the user had when
+they issued the call, even if they ``mr.set(...)`` afterwards).  A
+:class:`Plan` is the ordered stage chain plus a structural fingerprint
+used as the first component of the plan-cache key.
+
+The IR stays deliberately tiny: fusibility is NOT decided here — the
+fuser classifies stages against the *live* dataset/backend state at
+execution time (a chain is device-fusible or not depending on what the
+preceding stages produced), so a stage only carries what the user said,
+never a guessed tier.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass
+class PlanStage:
+    op: str                      # MapReduce method name (aggregate, ...)
+    args: tuple = ()
+    kw: dict = field(default_factory=dict)
+    settings: object = None      # Settings snapshot at record time
+    result: Optional[int] = None  # global pair/group count, set at execution
+
+    def signature(self) -> tuple:
+        """Hashable structural identity for cache keys: op name plus the
+        identity of any callback/flag arguments.  Callbacks hash by
+        function object — the same registered kernel recurs across runs,
+        a fresh lambda per run correctly misses."""
+        def _sig(x):
+            if callable(x):
+                return ("fn", x)
+            if isinstance(x, (int, float, str, bytes, bool, type(None))):
+                return x
+            return ("repr", repr(x))
+        return (self.op,
+                tuple(_sig(a) for a in self.args),
+                tuple(sorted((k, _sig(v)) for k, v in self.kw.items())))
+
+    def describe(self) -> str:
+        parts = [repr(a) if not callable(a)
+                 else getattr(a, "__name__", repr(a)) for a in self.args]
+        parts += [f"{k}={getattr(v, '__name__', None) or v!r}"
+                  for k, v in self.kw.items()]
+        return f"{self.op}({', '.join(parts)})"
+
+
+class Plan:
+    """One recorded stage chain, in issue order."""
+
+    def __init__(self, stages: Tuple[PlanStage, ...]):
+        self.stages = tuple(stages)
+
+    def fingerprint(self) -> tuple:
+        return tuple(s.signature() for s in self.stages)
+
+    def describe(self) -> list:
+        return [s.describe() for s in self.stages]
+
+    def __len__(self) -> int:
+        return len(self.stages)
+
+    def __repr__(self):
+        return f"Plan([{', '.join(self.describe())}])"
+
+
+def snapshot_settings(settings):
+    return copy.deepcopy(settings)
+
+
+def frame_signature(frame) -> tuple:
+    """Shape/dtype identity of the dataset the plan will run over — the
+    second component of the plan-cache key.  Host columnar frames key on
+    column kind + dtype; sharded frames on the padded device shapes."""
+    import numpy as np
+    kind = type(frame).__name__
+    sig = [kind]
+    for name in ("key", "value"):
+        col = getattr(frame, name, None)
+        if col is None:
+            continue
+        data = getattr(col, "data", col)
+        try:
+            arr = np.asarray(data) if not hasattr(data, "shape") else data
+            sig.append((name, tuple(arr.shape), str(arr.dtype)))
+        except Exception:
+            sig.append((name, "object"))
+    return tuple(sig)

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# CI gate: the ROADMAP tier-1 suite plus a fast fused-plan equivalence
+# subset (tests/test_plan.py) so a fusion regression fails loudly even
+# when only the quick gate runs.
+#
+#   scripts/ci.sh          # tier-1 + plan subset
+#   scripts/ci.sh quick    # plan subset only (~1 min)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run_plan_subset() {
+  echo "== plan equivalence subset (fast) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_plan.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+if [ "${1:-}" = "quick" ]; then
+  run_plan_subset
+  exit 0
+fi
+
+echo "== tier-1 (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+rc=0
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log || rc=$?
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+[ "$rc" -eq 0 ] || exit "$rc"
+
+run_plan_subset

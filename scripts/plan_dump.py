@@ -1,0 +1,47 @@
+"""Print the recorded plan (stages, fusion groups, cache key) for an
+OINK script — the offline twin of the ``dump_plan`` script command::
+
+    python scripts/plan_dump.py examples/in.wordfreq -var files data.txt
+
+Runs the script with ``fuse`` defaulted on (every MR the script creates
+records/fuses; an explicit ``-var fuse 0`` keeps your script's own
+``set fuse ${fuse}`` line authoritative) and prints every plan that
+executed: which stages fused into which compiled groups, which fell
+back to the eager path, and whether the plan cache hit.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    infile, rest = argv[0], argv[1:]
+    # default the MR `fuse` setting on for every object the script makes
+    os.environ.setdefault("MRTPU_FUSE", "1")
+    # ... and the `fuse` script variable too, so scripts carrying their
+    # own `set fuse ${fuse}` line (default 0) still record plans unless
+    # the user explicitly passed -var fuse 0
+    if not any(rest[i] in ("-var", "-v") and rest[i + 1] == "fuse"
+               for i in range(len(rest) - 1)):
+        rest = rest + ["-var", "fuse", "1"]
+    from gpu_mapreduce_tpu.oink.commands.dump_plan import format_plans
+    from gpu_mapreduce_tpu.oink.script import main as oink_main
+    from gpu_mapreduce_tpu.plan import clear_history, plan_history
+
+    clear_history()
+    rc = oink_main(["-in", infile, "-log", "none"] + rest)
+    print(format_plans(plan_history()))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
