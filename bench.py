@@ -41,6 +41,15 @@ CORPUS_CACHE_VERSION = "1"   # bump on generator-affecting edits outside
                              # make_corpus's own source (ADVICE r4)
 
 
+def host_id() -> str:
+    """Coarse host fingerprint recorded into the bench detail.  Wall
+    numbers are only comparable same-host: the bench_compare gate
+    refuses to compare records whose hosts differ (a fresh run on a
+    slower container must read as 'no baseline', not 'regression')."""
+    import platform
+    return f"{platform.node()}:{os.cpu_count()}cpu"
+
+
 def tb_tail(tb_text: str, n: int) -> str:
     """Last n informative lines of a formatted traceback.  jax appends a
     traceback-filtering epilogue ('JAX has removed its internal frames
@@ -292,6 +301,47 @@ def _knobs():
 
 
 FUSE_MODE = None   # --fuse {0,1,ab} (or BENCH_FUSE); None = skip A/B
+GATE = False       # --gate: after the run, regress-check against the
+#                    BENCH_r*.json trailing baseline (scripts/
+#                    bench_compare.py) and exit nonzero on a trip
+
+
+def run_gate(record: dict) -> int:
+    """Compare the fresh run against the trailing BENCH_r*.json
+    baseline (scripts/bench_compare.py, loaded by path — scripts/ is
+    not a package).  Prints the markdown verdict; returns the exit
+    code (0 pass / no-baseline, 1 regression).  A gate bug must not
+    turn a finished bench into a crash — errors report and pass."""
+    try:
+        import importlib.util
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "bench_compare", os.path.join(here, "scripts",
+                                          "bench_compare.py"))
+        bc = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bc)
+        candidate = bc.record_metrics(record)
+        if candidate is None:
+            # a degenerate run (value 0) has nothing to gate; compare()
+            # must not fall back to re-judging the last persisted round
+            print(json.dumps({"gate": "no usable candidate metrics"}),
+                  file=sys.stderr)
+            return 0
+        verdict = bc.compare(bc.load_series(here), candidate,
+                             threshold_pct=float(
+                                 os.environ.get("BENCH_GATE_PCT",
+                                                bc.DEFAULT_THRESHOLD_PCT)))
+        print(bc.markdown(verdict), file=sys.stderr)
+        print(json.dumps({"gate": {k: verdict.get(k) for k in
+                                   ("verdict", "regressions",
+                                    "baseline_rounds")}}),
+              file=sys.stderr)
+        return 0 if verdict["ok"] else 1
+    except Exception:
+        print(json.dumps({"gate_error":
+                          tb_tail(traceback.format_exc(), 3)[-300:]}),
+              file=sys.stderr)
+        return 0
 
 
 def plan_ab_record(mode: str, comm) -> dict:
@@ -400,6 +450,7 @@ def run_bench(engine, backend_err):
     map_bytes_per_sec = nbytes / map_time
     detail = {
         "npairs": npairs, "nunique": nunique, "bytes": nbytes,
+        "host": host_id(),
         "corpus": {"mb": total_mb, "skew": skew, "dense": dense},
         "map_stage_sec": round(map_time, 4),
         "map_stage_bytes_per_sec": round(map_bytes_per_sec, 1),
@@ -441,11 +492,16 @@ def run_bench(engine, backend_err):
          round(map_bytes_per_sec / BASELINE_BYTES_PER_SEC, 4),
          error=backend_err, backend=jax.default_backend(),
          engine=idx.engine)
+    # the flat record the --gate regression check consumes
+    return {"metric": METRIC, "value": round(pairs_per_sec, 1),
+            "backend": jax.default_backend(), "engine": idx.engine,
+            "detail": detail}
 
 
 def main():
-    global FUSE_MODE
+    global FUSE_MODE, GATE
     argv = sys.argv[1:]
+    GATE = "--gate" in argv or os.environ.get("BENCH_GATE") == "1"
     if "--fuse" in argv:
         i = argv.index("--fuse")
         FUSE_MODE = argv[i + 1] if i + 1 < len(argv) else "ab"
@@ -489,7 +545,9 @@ def main():
             engines = [force_engine]
         for i, engine in enumerate(engines):
             try:
-                run_bench(engine, backend_err)
+                rec = run_bench(engine, backend_err)
+                if GATE:
+                    sys.exit(run_gate(rec))
                 return
             except Exception:
                 # Exception, not BaseException: a KeyboardInterrupt or

@@ -168,7 +168,8 @@ class MapReduce:
     """One MapReduce object owns at most one KV and/or one KMV
     (reference src/mapreduce.h:43-44)."""
 
-    def __init__(self, comm=None, trace=None, **settings):
+    def __init__(self, comm=None, trace=None, metrics_port=None,
+                 **settings):
         self.error = Error()
         self.settings = Settings(**settings)
         self.settings.validate(self.error)
@@ -181,6 +182,20 @@ class MapReduce:
         if trace:
             self.tracer.enable(jsonl=trace if isinstance(trace, str)
                                else None)
+        # live metrics are process-global too: `metrics_port=N` arms the
+        # registry + span bridge and serves /metrics on localhost:N (the
+        # MRTPU_METRICS_PORT env var does the same; obs/httpd.py).  A
+        # bind failure (port already taken by a sibling process) warns
+        # instead of killing the constructor — metrics must never fail
+        # the app they observe
+        if metrics_port is not None:
+            try:
+                from ..obs.httpd import ensure_server
+                ensure_server(int(metrics_port))
+            except Exception as e:
+                self.error.warning(
+                    f"metrics server on port {metrics_port!r} failed "
+                    f"({e!r}); continuing without live export")
         if comm is None or comm == 1 or (isinstance(comm, int)):
             self.backend = SerialBackend()
         else:
@@ -1143,13 +1158,19 @@ class MapReduce:
         tracing is enabled (obs/) — an ``"ops"`` per-op aggregate over
         the span ring (count / total_s / byte sums per op name), plus a
         ``"plan"`` section with the compile-cache telemetry (plan cache
-        + bounded shuffle jit caches: hits/misses/evictions)."""
+        + bounded shuffle jit caches: hits/misses/evictions), plus —
+        when the metrics registry is armed (obs/metrics.py) — a
+        ``"metrics"`` section with the full labeled registry snapshot
+        (op latency histograms, exchange byte counters, gauges)."""
         self._flush_plan()   # barrier: counters must include the chain
         out = self.counters.snapshot()
         if self.tracer.enabled:
             out["ops"] = self.tracer.stats()
         from ..plan.cache import cache_stats
         out["plan"] = cache_stats()
+        from ..obs import metrics as _metrics
+        if _metrics.enabled():
+            out["metrics"] = _metrics.snapshot()
         return out
 
     def cummulative_stats(self, level: int = 1, reset: int = 0):

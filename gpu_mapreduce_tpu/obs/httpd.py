@@ -1,0 +1,136 @@
+"""Live metrics export: a stdlib HTTP server on a daemon thread.
+
+``curl localhost:$MRTPU_METRICS_PORT/metrics`` during a run returns the
+Prometheus exposition text (op latency histograms, exchange byte
+counters, plan-cache hit ratio, HBM hi-water, ...) — the "watch a
+running soak" exposure the printf reports and post-hoc traces lack.
+
+Routes:
+
+* ``/metrics`` — Prometheus text format (version 0.0.4);
+* ``/metrics.json`` — the structured registry snapshot;
+* ``/flight`` — the flight recorder's current snapshot (without
+  writing an artifact); 404 when the recorder is not armed;
+* ``/healthz`` — liveness ("ok").
+
+Start with ``MRTPU_METRICS_PORT=9090`` in the environment,
+``MapReduce(metrics_port=9090)``, or :func:`ensure_server`.  Port 0
+binds an ephemeral port (tests); the bound port is on
+``MetricsServer.port``.  Binds 127.0.0.1 only — this is an operator
+loopback, not a public listener.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API name)
+        from . import metrics as _metrics
+        try:
+            path = self.path.split("?", 1)[0]
+            if path == "/metrics":
+                self._send(200, _metrics.prometheus_text().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/metrics.json":
+                self._send(200,
+                           json.dumps(_metrics.snapshot(),
+                                      default=str).encode(),
+                           "application/json")
+            elif path == "/flight":
+                from . import flight as _flight
+                rec = _flight.get()
+                if rec is None:
+                    self._send(404, b"flight recorder not armed\n",
+                               "text/plain")
+                else:
+                    from .sinks import _jsonable
+                    self._send(200,
+                               json.dumps(rec.snapshot("http"),
+                                          default=_jsonable).encode(),
+                               "application/json")
+            elif path == "/healthz":
+                self._send(200, b"ok\n", "text/plain")
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # a scrape bug must not kill the thread
+            try:
+                self._send(500, f"{e!r}\n".encode(), "text/plain")
+            except Exception:
+                pass
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class MetricsServer:
+    """One ThreadingHTTPServer on a daemon thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        """Bind + serve; returns the actual port (resolves port 0)."""
+        if self._httpd is not None:
+            return self.port
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="mrtpu-metrics-httpd")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+
+_SERVER: Optional[MetricsServer] = None
+_LOCK = threading.Lock()
+
+
+def ensure_server(port: int) -> MetricsServer:
+    """Start the process metrics server (idempotent: a second call
+    returns the running server — the first bound port wins, with a
+    stderr note when it differs from the requested port, so an
+    operator curling the port they asked for and getting connection
+    refused has a trail to the one actually serving)."""
+    global _SERVER
+    import sys
+    from . import metrics as _metrics
+    _metrics.enable_metrics()
+    with _LOCK:
+        if _SERVER is None or not _SERVER.running:
+            _SERVER = MetricsServer(port=port)
+            _SERVER.start()
+        elif port not in (0, _SERVER.port):
+            print(f"metrics server already on port {_SERVER.port}; "
+                  f"ignoring requested port {port}", file=sys.stderr)
+    return _SERVER
+
+
+def get_server() -> Optional[MetricsServer]:
+    return _SERVER

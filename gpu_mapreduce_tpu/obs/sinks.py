@@ -10,9 +10,12 @@ load it directly.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from collections import deque
-from typing import Callable, List
+from typing import Callable, List, Optional
+
+from ..utils.env import env_knob
 
 
 def _jsonable(x):
@@ -62,10 +65,28 @@ class RingSink:
 
 class JsonlSink:
     """One JSON event per line, flushed per event so a killed run still
-    leaves a readable trace."""
+    leaves a readable trace.
 
-    def __init__(self, path: str):
+    Bounded by size-based rotation so a multi-hour soak cannot fill the
+    disk: past ``max_bytes`` (``MRTPU_TRACE_MAX_MB``; 0/unset =
+    unbounded) the file rotates to ``path.1`` .. ``path.<keep>``
+    (``MRTPU_TRACE_KEEP``, default 3, oldest dropped) and a fresh
+    ``path`` opens.  Each rotation bumps the
+    ``mrtpu_trace_rotated_total`` metrics counter."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None,
+                 keep: Optional[int] = None):
         self.path = path
+        if max_bytes is None:
+            # env_knob: a typo'd knob warns and falls back — it must
+            # not crash the run the trace was meant to observe
+            mb = env_knob("MRTPU_TRACE_MAX_MB", float, 0.0)
+            max_bytes = int(mb * (1 << 20)) if mb > 0 else 0
+        self.max_bytes = max_bytes
+        if keep is None:
+            keep = env_knob("MRTPU_TRACE_KEEP", int, 3)
+        self.keep = max(1, int(keep))
+        self.rotations = 0
         self._f = open(path, "w")
         self._lock = threading.Lock()
 
@@ -74,6 +95,44 @@ class JsonlSink:
         with self._lock:
             self._f.write(line + "\n")
             self._f.flush()
+            if self.max_bytes and self._f.tell() >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        """Shift path.(i) → path.(i+1), current → path.1, reopen fresh
+        (caller holds the lock).  A rotation failure (permissions, a
+        vanished directory) keeps writing to the current file — a trace
+        must degrade, not raise into the traced op — and DISABLES
+        further rotation: retrying on every emit would pay a close/open
+        per span and inflate the rotation counter while rotating
+        nothing."""
+        try:
+            self._f.close()
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            self.max_bytes = 0            # broken: back to unbounded
+            self._reopen()
+            return
+        self._reopen()                    # fresh file (rename moved it)
+        self.rotations += 1
+        from .metrics import note_trace_rotated
+        note_trace_rotated()
+
+    def _reopen(self) -> None:
+        """Reopen the live file after a rotation attempt.  If even that
+        fails (directory vanished, ENOSPC at create), the sink goes
+        inert on /dev/null rather than raising out of emit() — a
+        raising sink gets dropped by the tracer and the rest of a
+        multi-hour run would leave no trace at all."""
+        try:
+            self._f = open(self.path, "a")
+        except OSError:
+            self.max_bytes = 0
+            self._f = open(os.devnull, "w")
 
     def close(self) -> None:
         with self._lock:

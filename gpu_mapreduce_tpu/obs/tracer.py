@@ -216,6 +216,21 @@ class Tracer:
         with self._lock:
             self._sinks.append(CallbackSink(fn))
 
+    def subscribe_once(self, fn) -> None:
+        """subscribe() unless ``fn`` already is — check and append under
+        ONE lock hold, so concurrent enables (two threads constructing
+        MapReduce(metrics_port=...)) cannot double-subscribe the metrics
+        bridge / flight ring and double-count every span; long-lived
+        consumers also re-arm safely after a reset().  Membership is by
+        ``==``, not ``is``: a bound method (the flight recorder's
+        ``rec.emit``) is a fresh object per access but compares equal."""
+        from .sinks import CallbackSink
+        self.enable()
+        with self._lock:
+            if not any(isinstance(s, CallbackSink) and s.fn == fn
+                       for s in self._sinks):
+                self._sinks.append(CallbackSink(fn))
+
     def reset(self) -> None:
         """Drop sinks/events and disable (test isolation)."""
         self.enabled = False

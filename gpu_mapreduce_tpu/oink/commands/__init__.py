@@ -1,5 +1,5 @@
 """Command plugin modules — importing registers each with the
 COMMANDS registry (the generated style_command.h of the reference)."""
 
-from . import (cc, degree, dump_plan, dump_trace, edges, histo,  # noqa: F401
-               luby, pagerank, rmat, sssp, tri, wordfreq)
+from . import (cc, degree, dump_metrics, dump_plan, dump_trace,  # noqa: F401
+               edges, histo, luby, pagerank, rmat, sssp, tri, wordfreq)

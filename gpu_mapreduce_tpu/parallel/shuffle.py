@@ -524,6 +524,11 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
                     key_decode=skv.key_decode,
                     value_decode=skv.value_decode)
     out.exchange_stats = stats   # per-call telemetry rides the result
+    # live metrics (obs/metrics.py): the same per-call numbers feed the
+    # exchange byte/round counters — a direct feed, not via the span, so
+    # the counters are exact even for spans the ring has already evicted
+    from ..obs.metrics import record_exchange
+    record_exchange(stats)
     return out
 
 

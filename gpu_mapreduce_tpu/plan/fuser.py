@@ -415,11 +415,15 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
 
 
 def _account_exchange(mr, skv, counts_mat, B, nrounds, nprocs, stats):
+    from ..obs.metrics import record_exchange
     from ..parallel.shuffle import exchange_volume
     moved, pad, _rowbytes = exchange_volume(skv, counts_mat, B, nrounds,
                                             nprocs)
     mr.counters.add(cssize=moved, crsize=moved, cspad=pad)
     stats.sent_bytes, stats.pad_bytes = moved, pad
+    # the fused tier's twin of the eager _exchange_impl feed: without it
+    # a MRTPU_FUSE=1 run reads "no exchange traffic" on /metrics
+    record_exchange(stats)
 
 
 def _exec_local_group(mr, stages, reduce_op, sp, frame):

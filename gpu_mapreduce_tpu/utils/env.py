@@ -1,0 +1,28 @@
+"""Crash-proof numeric env-knob parsing.
+
+Observability knobs share one rule (doc/settings.md): a malformed value
+must degrade with a stderr warning, never crash the run it was meant to
+observe.  Every numeric MRTPU_*/SOAK_* knob parses through here so the
+warn-and-fall-back behavior cannot drift between sites.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+def env_knob(name: str, cast: Callable[[str], T], default: T) -> T:
+    """``cast(os.environ[name])``, or ``default`` (with one stderr
+    line) when the variable is unset, empty, or malformed."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return cast(raw)
+    except (TypeError, ValueError) as e:
+        print(f"{name} ignored: {e!r}", file=sys.stderr)
+        return default

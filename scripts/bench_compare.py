@@ -1,0 +1,287 @@
+"""Bench regression gate: compare the latest bench run against a
+trailing baseline from the BENCH_r*.json series.
+
+The bench trajectory was write-only — rounds appended BENCH_r<N>.json
+records but nothing ever read them back, so a regression between rounds
+surfaced only if a human eyeballed the numbers.  This script closes the
+loop:
+
+* load the series (each record: ``{"n", "rc", "tail", "parsed"}`` — the
+  driver's capture of one ``bench.py`` stdout metric line plus the
+  stderr ``{"detail": ...}`` line embedded in ``tail``);
+* pick the candidate (the highest-round record, an explicit
+  ``--candidate FILE``, or a JSON record on stdin with ``-``);
+* baseline = per-metric **median of the trailing window** of records
+  comparable to the candidate (same backend + engine — a CPU-fallback
+  round must never gate against a TPU round);
+* a metric regresses when it moves past ``--threshold-pct`` in its bad
+  direction (rates down, wall/dispatches up);
+* emit a markdown verdict table (``--md PATH``, ``-`` for stdout) and a
+  JSON verdict (``--json PATH``).
+
+Exit code: always 0 in advisory mode (the ``scripts/ci.sh`` step);
+with ``--gate`` (what ``bench.py --gate`` runs) nonzero iff a metric
+regressed.  A missing/too-short series is a "no-baseline" pass — the
+gate can only fire on evidence.
+
+Usage:
+    python scripts/bench_compare.py [--dir REPO] [--candidate FILE|-]
+        [--window K] [--threshold-pct P] [--md PATH] [--json PATH]
+        [--gate]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+# (metric key, direction): +1 = higher is better (a drop regresses),
+# -1 = lower is better (a rise regresses)
+METRICS = (
+    ("pairs_per_sec", +1),
+    ("map_stage_bytes_per_sec", +1),
+    ("end_to_end_bytes_per_sec", +1),
+    ("map_stage_sec", -1),
+    ("end_to_end_sec", -1),
+    # fused and eager dispatch counts are separate metrics: a round run
+    # with a different --fuse mode must not read as a dispatch
+    # regression (each key only compares when both sides recorded it)
+    ("dispatches_fused", -1),
+    ("dispatches_eager", -1),
+)
+
+DEFAULT_WINDOW = 3
+DEFAULT_THRESHOLD_PCT = 50.0
+
+
+def extract_detail(tail: str) -> dict:
+    """The stderr ``{"detail": ...}`` JSON line embedded in a record's
+    captured tail (last one wins — retries emit several)."""
+    detail = {}
+    for line in tail.splitlines():
+        if '"detail"' not in line:
+            continue
+        try:
+            d = json.loads(line.strip())
+        except ValueError:
+            continue
+        if isinstance(d, dict) and isinstance(d.get("detail"), dict):
+            detail = d["detail"]
+    return detail
+
+
+def record_metrics(rec: dict) -> Optional[dict]:
+    """One loaded record → a flat comparable-metrics dict, or None when
+    the round produced no usable number (rc!=0, error-only line).
+
+    Accepts both the driver's BENCH_r schema ({"n","rc","tail","parsed"})
+    and a flat bench record ({"metric","value",...,"detail":{...}} —
+    what ``bench.py --gate`` hands over for the fresh run)."""
+    parsed = rec.get("parsed")
+    if parsed is None and "metric" in rec:
+        parsed = rec
+    if not isinstance(parsed, dict) or parsed.get("value") in (None, 0,
+                                                               0.0):
+        return None
+    det = rec.get("detail") or parsed.get("detail") \
+        or extract_detail(rec.get("tail", ""))
+    m = {"pairs_per_sec": parsed["value"],
+         "backend": parsed.get("backend") or det.get("backend"),
+         "engine": parsed.get("engine") or det.get("engine"),
+         "host": det.get("host"),
+         "round": rec.get("n")}
+    for k in ("map_stage_sec", "end_to_end_sec",
+              "map_stage_bytes_per_sec", "end_to_end_bytes_per_sec"):
+        v = det.get(k)
+        if v is not None:
+            m[k] = v
+    pa = det.get("plan_ab") or {}
+    for variant in ("fused", "eager"):
+        d = (pa.get(variant) or {}).get("dispatches")
+        if d is not None:
+            m[f"dispatches_{variant}"] = d
+    # corpus shape must match for wall times to be comparable at all
+    # (normalized: older rounds predate the skew/dense keys)
+    corpus = det.get("corpus")
+    if corpus:
+        m["corpus"] = (corpus.get("mb"), bool(corpus.get("skew")),
+                       bool(corpus.get("dense")))
+    return m
+
+
+def load_series(dirpath: str) -> List[dict]:
+    """Every usable BENCH_r*.json record under dirpath, round order."""
+    recs = []
+    for path in glob.glob(os.path.join(dirpath, "BENCH_r*.json")):
+        mnum = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not mnum:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rec.setdefault("n", int(mnum.group(1)))
+        m = record_metrics(rec)
+        if m is not None:
+            recs.append(m)
+    recs.sort(key=lambda m: (m.get("round") is None, m.get("round")))
+    return recs
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2
+
+
+def compare(series: List[dict], candidate: Optional[dict] = None,
+            window: int = DEFAULT_WINDOW,
+            threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> dict:
+    """The verdict dict.  With no explicit candidate the latest series
+    record is the candidate and the rest the baseline pool."""
+    if candidate is None:
+        if len(series) < 1:
+            return {"ok": True, "verdict": "no-candidate",
+                    "threshold_pct": threshold_pct, "rows": [],
+                    "regressions": []}
+        candidate, series = series[-1], series[:-1]
+    cc = candidate.get("corpus")
+    pool = [m for m in series
+            if m.get("backend") == candidate.get("backend")
+            and m.get("engine") == candidate.get("engine")
+            # wall numbers are only comparable same-host: hosts must be
+            # EQUAL (both-absent counts — pre-host records gate each
+            # other; a fresh run on a different/slower machine than the
+            # recorded series reads as no-baseline, never regression)
+            and m.get("host") == candidate.get("host")
+            # corpus gates only when both sides record it (the key
+            # appeared mid-series; a missing one is a wildcard)
+            and (m.get("corpus") is None or cc is None
+                 or m["corpus"] == cc)][-window:]
+    out = {"threshold_pct": threshold_pct,
+           "candidate_round": candidate.get("round"),
+           "baseline_rounds": [m.get("round") for m in pool],
+           "backend": candidate.get("backend"),
+           "engine": candidate.get("engine"),
+           "rows": [], "regressions": []}
+    if not pool:
+        out.update(ok=True, verdict="no-baseline")
+        return out
+    for key, direction in METRICS:
+        vals = [m[key] for m in pool if key in m]
+        if not vals or key not in candidate:
+            continue
+        base = _median(vals)
+        latest = candidate[key]
+        if not base:
+            continue
+        delta_pct = (latest - base) / base * 100.0
+        regressed = (delta_pct < -threshold_pct if direction > 0
+                     else delta_pct > threshold_pct)
+        out["rows"].append({"metric": key, "baseline": base,
+                            "latest": latest,
+                            "delta_pct": round(delta_pct, 2),
+                            "direction": ("higher_better" if direction > 0
+                                          else "lower_better"),
+                            "regressed": regressed})
+        if regressed:
+            out["regressions"].append(key)
+    out["ok"] = not out["regressions"]
+    out["verdict"] = "regression" if out["regressions"] else "pass"
+    return out
+
+
+def markdown(v: dict) -> str:
+    """The human verdict table for CI logs / PR comments."""
+    head = (f"## bench_compare: **{v['verdict'].upper()}** "
+            f"(threshold {v['threshold_pct']:g}%, "
+            f"baseline rounds {v.get('baseline_rounds') or '—'}, "
+            f"candidate round {v.get('candidate_round') or 'fresh'}, "
+            f"{v.get('backend')}/{v.get('engine')})")
+    if not v["rows"]:
+        return head + "\n\n(no comparable metrics — gate cannot fire)"
+    lines = [head, "",
+             "| metric | baseline (median) | latest | Δ% | verdict |",
+             "|---|---:|---:|---:|---|"]
+    for r in v["rows"]:
+        lines.append(
+            f"| {r['metric']} | {r['baseline']:g} | {r['latest']:g} "
+            f"| {r['delta_pct']:+.1f}% "
+            f"| {'REGRESSED' if r['regressed'] else 'ok'} |")
+    return "\n".join(lines)
+
+
+def _write(path: str, text: str) -> None:
+    if path == "-":
+        print(text)
+    else:
+        with open(path, "w") as f:
+            f.write(text + "\n")
+
+
+def main(argv: List[str]) -> int:
+    dirpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "..")
+    candidate_path = None
+    window = DEFAULT_WINDOW
+    threshold = float(os.environ.get("BENCH_GATE_PCT",
+                                     DEFAULT_THRESHOLD_PCT))
+    md_out = "-"
+    json_out = None
+    gate = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(__doc__.strip())
+            return 0
+        if a == "--gate":
+            gate = True
+            i += 1
+            continue
+        if a in ("--dir", "--candidate", "--window", "--threshold-pct",
+                 "--md", "--json"):
+            if i + 1 >= len(argv):
+                print(f"{a} needs a value", file=sys.stderr)
+                return 2
+            val = argv[i + 1]
+            if a == "--dir":
+                dirpath = val
+            elif a == "--candidate":
+                candidate_path = val
+            elif a == "--window":
+                window = int(val)
+            elif a == "--threshold-pct":
+                threshold = float(val)
+            elif a == "--md":
+                md_out = val
+            else:
+                json_out = val
+            i += 2
+            continue
+        print(f"unknown argument: {a}", file=sys.stderr)
+        return 2
+    candidate = None
+    if candidate_path:
+        raw = sys.stdin.read() if candidate_path == "-" else \
+            open(candidate_path).read()
+        candidate = record_metrics(json.loads(raw))
+        if candidate is None:
+            print("candidate record has no usable metrics",
+                  file=sys.stderr)
+            return 2 if gate else 0
+    verdict = compare(load_series(dirpath), candidate,
+                      window=window, threshold_pct=threshold)
+    _write(md_out, markdown(verdict))
+    if json_out:
+        _write(json_out, json.dumps(verdict, indent=2))
+    return (1 if gate and not verdict["ok"] else 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,10 +1,12 @@
 #!/usr/bin/env bash
-# CI gate: the ROADMAP tier-1 suite plus a fast fused-plan equivalence
-# subset (tests/test_plan.py) so a fusion regression fails loudly even
-# when only the quick gate runs.
+# CI gate: the ROADMAP tier-1 suite plus fast subsets (fused-plan
+# equivalence, metrics/flight-recorder) so a regression there fails
+# loudly even when only the quick gate runs, and an ADVISORY bench
+# regression check (scripts/bench_compare.py) that prints its verdict
+# table into the CI log but never fails the build.
 #
-#   scripts/ci.sh          # tier-1 + plan subset
-#   scripts/ci.sh quick    # plan subset only (~1 min)
+#   scripts/ci.sh          # tier-1 + plan/metrics subsets + advisory gate
+#   scripts/ci.sh quick    # plan + metrics subsets only (~1 min)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,8 +16,24 @@ run_plan_subset() {
       -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_metrics_subset() {
+  echo "== metrics / flight-recorder subset (fast) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_metrics.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+bench_compare_advisory() {
+  # advisory only: the verdict table lands in the CI log; a regression
+  # (or a compare bug) must not fail the build — bench.py --gate is the
+  # hard version
+  echo "== bench_compare (advisory) =="
+  python scripts/bench_compare.py --md - || true
+}
+
 if [ "${1:-}" = "quick" ]; then
   run_plan_subset
+  run_metrics_subset
+  bench_compare_advisory
   exit 0
 fi
 
@@ -30,3 +48,5 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
 [ "$rc" -eq 0 ] || exit "$rc"
 
 run_plan_subset
+run_metrics_subset
+bench_compare_advisory

@@ -27,6 +27,11 @@
 cd /root/repo || exit 1
 LOG=/tmp/tpu_watch.log
 PROBELOG=/root/repo/TPU_PROBE_LOG.txt
+# structured twin of PROBELOG: one JSON event per line (ts, phase, rc,
+# latency_s) — `python scripts/trace_view.py --probe TPU_PROBE_LOG.jsonl`
+# prints the uptime/failure-streak summary the r5 543-FAIL text log
+# could not answer without hand-grepping
+PROBEJSON=/root/repo/TPU_PROBE_LOG.jsonl
 PROOF_OK=0; BENCH_OK=0; SOAK_OK=0
 [ -f MOSAIC_PROOF.json ] && grep -q '"oracle_match": true' MOSAIC_PROOF.json && PROOF_OK=1
 # seed the /tmp done-flags from committed on-chip artifacts (a restart
@@ -70,13 +75,22 @@ cpu_ticks() {  # utime+stime ticks of pid $1 and all its descendants
   echo $total
 }
 
-probe_ok() {  # probe_ok [timeout]: live tunnels answer in ~10-40s; a
-  # DOWN tunnel burns the whole timeout, so the scan loop probes fast
-  # (90s) to shrink the window-miss gap, while per-step re-probes keep
-  # the patient 240s
+probe_event() {  # probe_event <phase> <rc> <latency_s>
+  printf '{"ts":"%s","phase":"%s","rc":%d,"latency_s":%d}\n' \
+    "$(date -u +%FT%TZ)" "$1" "$2" "$3" >>"$PROBEJSON" 2>/dev/null
+}
+
+probe_ok() {  # probe_ok [timeout] [phase]: live tunnels answer in
+  # ~10-40s; a DOWN tunnel burns the whole timeout, so the scan loop
+  # probes fast (90s) to shrink the window-miss gap, while per-step
+  # re-probes keep the patient 240s.  Every attempt lands in PROBEJSON.
+  local t0=$(date +%s) rc
   timeout "${1:-240}" python -c \
     "import jax; b = jax.default_backend(); assert b in ('tpu','axon'), b" \
     2>>"$LOG"
+  rc=$?
+  probe_event "${2:-probe}" "$rc" $(( $(date +%s) - t0 ))
+  return $rc
 }
 
 on_chip() {  # on_chip <json-file>: true iff the artifact records a real
@@ -87,8 +101,9 @@ on_chip() {  # on_chip <json-file>: true iff the artifact records a real
 
 run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
   local name=$1 tmo=$2; shift 2
-  if ! probe_ok; then
+  if ! probe_ok 240 "pre.$name"; then
     echo "$(date -u +%FT%TZ) skip $name (tunnel gone)" >>"$PROBELOG"
+    probe_event "step.$name" 9 0
     return 9
   fi
   "$@" & local pid=$!
@@ -103,20 +118,25 @@ run_step() {  # run_step <name> <overall-timeout-s> <cmd...>
         >>"$PROBELOG"
       kill_tree $pid TERM; sleep 5; kill_tree $pid KILL
       wait $pid 2>/dev/null
+      probe_event "step.$name" 8 $((now - t0))
       return 8
     fi
     if [ $((now - t0)) -ge "$tmo" ]; then
       echo "$(date -u +%FT%TZ) $name TIMEOUT ${tmo}s — killed" >>"$PROBELOG"
       kill_tree $pid TERM; sleep 5; kill_tree $pid KILL
       wait $pid 2>/dev/null
+      probe_event "step.$name" 7 $((now - t0))
       return 7
     fi
   done
   wait $pid
+  local rc=$?
+  probe_event "step.$name" $rc $(( $(date +%s) - t0 ))
+  return $rc
 }
 
 while true; do
-  if probe_ok 90; then
+  if probe_ok 90 scan; then
     echo "$(date -u +%FT%TZ) probe OK (proof=$PROOF_OK bench=$BENCH_OK soak=$SOAK_OK)" >>"$PROBELOG"
     # an idle machine for the window: pause any running test suites (the
     # 03:22Z capture recorded read=16s for 256MB under a pytest run)

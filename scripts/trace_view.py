@@ -3,12 +3,18 @@
 Usage:
     python scripts/trace_view.py TRACE.jsonl [--chrome OUT.json]
                                              [--cat CAT] [--json]
+    python scripts/trace_view.py --probe PROBE.jsonl [--json]
 
 TRACE.jsonl is what a run writes under MRTPU_TRACE=path (or
 MapReduce(trace=path)).  --chrome additionally writes the
 Perfetto-loadable Chrome trace-event file; --cat filters to one span
 category (mr_op / shuffle / ingest / oink / app / soak); --json prints
 the aggregate as JSON instead of the table.
+
+--probe summarizes a TPU probe JSONL (scripts/tpu_watch.sh writes one
+event {"ts","phase","rc","latency_s"} per probe/step attempt) into an
+uptime/failure-streak table — the question the r5 window's 543
+consecutive text-log FAILs couldn't answer at a glance.
 """
 import json
 import os
@@ -18,10 +24,104 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
+def probe_summary(events) -> dict:
+    """Aggregate probe JSONL events: overall uptime + longest failure
+    streak (with its time bounds) over probe-type phases (``step.*``
+    events are step outcomes, tabulated per phase but excluded from the
+    tunnel-uptime headline)."""
+    probes = [e for e in events if isinstance(e.get("rc"), int)
+              and not str(e.get("phase", "")).startswith("step.")]
+    ok = sum(1 for e in probes if e["rc"] == 0)
+    streak = {"len": 0, "start": None, "end": None}
+    cur_len, cur_start, last_ts = 0, None, None
+    for e in probes:
+        if e["rc"] != 0:
+            if cur_len == 0:
+                cur_start = e.get("ts")
+            cur_len += 1
+            last_ts = e.get("ts")
+            if cur_len > streak["len"]:
+                streak = {"len": cur_len, "start": cur_start,
+                          "end": last_ts}
+        else:
+            cur_len = 0
+    phases = {}
+    for e in events:
+        if not isinstance(e.get("rc"), int):
+            continue
+        ph = str(e.get("phase", "?"))
+        row = phases.setdefault(ph, {"count": 0, "ok": 0, "fail": 0,
+                                     "fail_streak": 0, "_cur": 0,
+                                     "latency_s_sum": 0})
+        row["count"] += 1
+        row["latency_s_sum"] += e.get("latency_s", 0) or 0
+        if e["rc"] == 0:
+            row["ok"] += 1
+            row["_cur"] = 0
+        else:
+            row["fail"] += 1
+            row["_cur"] += 1
+            row["fail_streak"] = max(row["fail_streak"], row["_cur"])
+    for row in phases.values():
+        del row["_cur"]
+    return {"probes": len(probes), "ok": ok,
+            "fail": len(probes) - ok,
+            "uptime_pct": round(100.0 * ok / len(probes), 2)
+            if probes else 0.0,
+            "longest_fail_streak": streak,
+            "current_fail_streak": cur_len,
+            "phases": phases}
+
+
+def probe_table(events) -> str:
+    s = probe_summary(events)
+    st = s["longest_fail_streak"]
+    lines = [f"probes: {s['probes']} ({s['ok']} ok, {s['fail']} fail, "
+             f"{s['uptime_pct']}% up); longest fail streak "
+             f"{st['len']}" + (f" ({st['start']} – {st['end']})"
+                               if st["len"] else "")
+             + f"; current streak {s['current_fail_streak']}"]
+    rows = [("phase", "count", "ok", "fail", "max_streak", "avg_lat_s")]
+    for ph in sorted(s["phases"]):
+        r = s["phases"][ph]
+        rows.append((ph, str(r["count"]), str(r["ok"]), str(r["fail"]),
+                     str(r["fail_streak"]),
+                     f"{r['latency_s_sum'] / max(1, r['count']):.1f}"))
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) if j == 0 else c.rjust(w)
+                               for j, (c, w) in enumerate(zip(row, widths))))
+        if i == 1:
+            lines.insert(2, "  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__.strip())
         return 0 if argv else 1
+    if argv[0] == "--probe":
+        if len(argv) < 2:
+            print("--probe needs a JSONL path", file=sys.stderr)
+            return 1
+        # read inline, NOT via gpu_mapreduce_tpu.obs: importing the
+        # package pulls in jax (seconds on the watcher box) and runs
+        # the import-time metrics env hooks — a dead-tunnel diagnostic
+        # must not try to bind MRTPU_METRICS_PORT
+        events = []
+        with open(argv[1]) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        pass  # truncated final line from a killed run
+        if "--json" in argv[2:]:
+            print(json.dumps(probe_summary(events), indent=2))
+        else:
+            print(probe_table(events))
+        return 0
     path = argv[0]
     chrome = None
     cat = None

@@ -13,16 +13,59 @@ framework surface:
   the BASELINE.json north-star metric (the reference's pagerank is a
   stub, oink/pagerank.cpp:53-55, so this races no reference number)
 
-Usage:  python soak.py            (scale from SOAK_SCALE, default 18)
+Usage:  python soak.py [--metrics-every N]
+        (scale from SOAK_SCALE, default 18; N also via
+        SOAK_METRICS_EVERY — print a live metrics snapshot line after
+        every N workloads and write a final full-registry snapshot to
+        SOAK_METRICS_OUT, default soak_metrics.json, next to the log)
 Writes: BASELINE.json published.{rmat_edges_per_sec, degree_edges_per_sec,
         cc_find_edges_per_sec_per_iter, pagerank_edges_per_sec_per_iter}
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+
+def metrics_line(n: int, name: str) -> str:
+    """One compact live-metrics JSON line (a multi-hour soak window is
+    watched by tailing the log; the full registry lands in the final
+    snapshot file): cumulative counters + plan-cache hit ratio after
+    workload #n."""
+    from gpu_mapreduce_tpu.core.runtime import global_counters
+    from gpu_mapreduce_tpu.plan.cache import cache_stats
+    c = global_counters().snapshot()
+    p = cache_stats()["plan"]
+    tot = p["hits"] + p["misses"]
+    return json.dumps({
+        "soak_metrics": {"after": name, "workload": n,
+                         "ndispatch": c["ndispatch"],
+                         "shuffle_mb": round(c["cssize"] / (1 << 20), 3),
+                         "pad_mb": round(c["cspad"] / (1 << 20), 3),
+                         "spill_mb": round(c["wsize"] / (1 << 20), 3),
+                         "hbm_hiwater_mb": round(c["msizemax"] / (1 << 20),
+                                                 3),
+                         "comm_s": round(c["commtime"], 3),
+                         "plan_hit_ratio": round(p["hits"] / tot, 3)
+                         if tot else 0.0}})
+
+
+def write_final_metrics(path: str) -> None:
+    """The full labeled registry snapshot + counters + cache stats, as
+    one JSON document next to the soak log."""
+    from gpu_mapreduce_tpu.core.runtime import global_counters
+    from gpu_mapreduce_tpu.obs import metrics as _metrics
+    from gpu_mapreduce_tpu.plan.cache import cache_stats
+    doc = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "counters": global_counters().snapshot(),
+           "plan": cache_stats(),
+           "metrics": _metrics.snapshot()}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, default=str)
+    print(f"final metrics snapshot -> {path}")
 
 
 def main():
@@ -44,6 +87,19 @@ def main():
     scale = int(os.environ.get("SOAK_SCALE", "18"))
     nnz = int(os.environ.get("SOAK_NNZ", "8"))
     nmesh = int(os.environ.get("SOAK_MESH", "1"))  # VERDICT r3 #6: P>1
+    # a malformed value warns and disables the live lines instead of
+    # killing a multi-hour capture window before its first workload
+    from gpu_mapreduce_tpu.utils.env import env_knob
+    metrics_every = env_knob("SOAK_METRICS_EVERY", int, 0)
+    if "--metrics-every" in sys.argv:
+        i = sys.argv.index("--metrics-every")
+        try:
+            metrics_every = int(sys.argv[i + 1]) \
+                if i + 1 < len(sys.argv) else 1
+        except ValueError as e:
+            print(f"--metrics-every ignored: {e!r}", file=sys.stderr)
+            metrics_every = 0
+
     backend = jax.default_backend()
     published = {}
     errors = {}
@@ -53,6 +109,11 @@ def main():
     # (MRTPU_TRACE additionally streams the JSONL trace file)
     from gpu_mapreduce_tpu.obs import get_tracer, per_op_table
     tracer = get_tracer().enable()
+    if metrics_every:
+        # live metrics (obs/metrics.py): span bridge + registry, so the
+        # periodic lines and the final snapshot have per-op histograms
+        from gpu_mapreduce_tpu.obs.metrics import enable_metrics
+        enable_metrics()
 
     def guard(name, fn):
         """One workload failing (a Mosaic rejection, a tunnel drop
@@ -266,14 +327,17 @@ def main():
               f"{len(e2) / per_iter:,.0f} edges/s/iter "
               f"(sum={float(np.asarray(ranks).sum()):.4f})")
 
-    guard("degree", do_degree)
-    guard("cc_find", do_cc)
-    guard("sssp", do_sssp)
-    guard("luby", do_luby)
-    guard("tri", do_tri)
-    guard("external", do_external)
-    guard("pagerank", do_pagerank)
-    guard("pagerank_northstar", do_pagerank_northstar)
+    workloads = [("degree", do_degree), ("cc_find", do_cc),
+                 ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),
+                 ("external", do_external), ("pagerank", do_pagerank),
+                 ("pagerank_northstar", do_pagerank_northstar)]
+    for i, (name, fn) in enumerate(workloads, 1):
+        guard(name, fn)
+        if metrics_every and i % metrics_every == 0:
+            print(metrics_line(i, name))
+    if metrics_every:
+        write_final_metrics(os.environ.get("SOAK_METRICS_OUT",
+                                           "soak_metrics.json"))
     if errors:
         published["errors"] = errors
 
