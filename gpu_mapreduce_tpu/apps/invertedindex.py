@@ -789,7 +789,11 @@ class InvertedIndex:
                 fstarts_g = jax.device_put(fstarts_host.reshape(-1),
                                            sharding)
                 base_g = jax.device_put(base_host, sharding)
-                jax.block_until_ready(words_g)
+                # timing-attribution sync only (keeps h2d out of the
+                # timed map stage); MRTPU_DEFER_SYNC=1 defers it to the
+                # extract's own stats pull so H2D overlaps dispatch
+                from ..exec import maybe_block
+                maybe_block(words_g)
 
             cap = max(8, 1 << (max(1, max_bytes // 1024) - 1).bit_length())
             wide = False
@@ -870,7 +874,11 @@ class InvertedIndex:
             with self.timer.stage("h2d"):
                 words = jax.device_put(jnp.asarray(bytes_view_u32(corpus)))
                 fstarts_d = jax.device_put(jnp.asarray(fstarts))
-                jax.block_until_ready(words)
+                # see _map_corpus_mesh: timing sync, deferrable via
+                # MRTPU_DEFER_SYNC (the stats device_get below is the
+                # real barrier)
+                from ..exec import maybe_block
+                maybe_block(words)
 
             # ~1 href/KB is the PUMA-style density; an overflow retries
             # with the exact power-of-two capacity

@@ -150,8 +150,13 @@ class KeyValue:
 
     def add_kv(self, other: "KeyValue"):
         """Append another KV's pairs (reference MapReduce::add,
-        src/mapreduce.cpp:348-374)."""
+        src/mapreduce.cpp:348-374).  Frame OBJECTS are shared, not
+        copied — mark them so the exchange's buffer donation (exec/,
+        MRTPU_DONATE) never deletes device arrays another dataset still
+        reads (an aggregate on one MR must not corrupt its copy())."""
         for fr in other.frames():
+            if not isinstance(fr, KVFrame):   # ShardedKV: device arrays
+                fr._shared = True             # now alias across datasets
             self._batches.append(fr)
 
     def add_frame(self, frame):

@@ -69,7 +69,12 @@ class _Run:
     member would decompress fully on every access — quadratic read
     amplification across refills).  Byte-string columns (object arrays)
     cannot mmap; they spill pickled and re-read whole per refill — the
-    rare path, only for string-keyed out-of-core sorts."""
+    rare path, only for string-keyed out-of-core sorts.
+
+    With the background spill writer (exec/spill.py) the files may still
+    be in flight when the merge starts: ``pending`` is the durability
+    barrier handle, and every read path goes through :meth:`wait_ready`
+    first — the reader can never observe a half-written run."""
 
     def __init__(self, kpath: str, vpath: str, n: int, counters,
                  kkind: str, vkind: str):
@@ -82,6 +87,22 @@ class _Run:
         self.vkind = vkind   # is recorded, never guessed from row values)
         self.buf: Optional[KVFrame] = None
         self.sur: Optional[np.ndarray] = None
+        self.pending = None  # exec.spill.Pending when written in background
+
+    def wait_ready(self):
+        """Durability barrier: block until this run is fully on disk
+        (re-raising a background-writer failure).  Foreground wait time
+        feeds the spill overlap ratio."""
+        if self.pending is None:
+            return
+        pending, self.pending = self.pending, None
+        try:
+            waited = pending.wait()
+        except BaseException:
+            self.pending = pending   # stay un-ready: a retry re-raises
+            raise
+        from ..exec import note_overlap
+        note_overlap("spill", wait_s=waited)
 
     def _load(self, path: str, start: int, stop: int, kind: str) -> Column:
         if kind == "dense":
@@ -96,6 +117,7 @@ class _Run:
     def refill(self, block_rows: int, by: str):
         if self.buf is not None or self.pos >= self.n:
             return
+        self.wait_ready()
         stop = min(self.pos + block_rows, self.n)
         self.buf = KVFrame(
             self._load(self.kpath, self.pos, stop, self.kkind),
@@ -127,7 +149,10 @@ class _Run:
         return self.sur[-1]
 
     def drop(self):
-        for p in (self.kpath, self.vpath):
+        # a failed background write may leave only the tmp sibling; a
+        # successful one only the final path — remove both forms
+        for p in (self.kpath, self.vpath,
+                  self.kpath + ".tmp", self.vpath + ".tmp"):
             try:
                 os.remove(p)
             except OSError:
@@ -144,28 +169,44 @@ def _col_kind(col: Column) -> str:
 
 
 def _save_col(col: Column, path: str):
+    from ..exec.spill import atomic_save
     if _col_kind(col) == "dense":
-        np.save(path, np.asarray(col.to_host().data))
+        atomic_save(path, np.asarray(col.to_host().data))
     else:
         # element-wise build: np.asarray(list, dtype=object) would turn
         # uniform-length tuple rows into a 2-D array and corrupt keys
         arr = np.empty(len(col), dtype=object)
         for i, x in enumerate(col.data):
             arr[i] = x
-        np.save(path, arr, allow_pickle=True)
+        atomic_save(path, arr, allow_pickle=True)
 
 
-def _write_run(fr: KVFrame, settings, counters, seq: int) -> _Run:
+def _write_run(fr: KVFrame, settings, counters, seq: int,
+               writer=None) -> _Run:
+    """Spill one sorted frame as a run.  With ``writer`` (an
+    exec.spill.SpillWriter) the write happens in the background and the
+    returned run carries the durability-barrier handle; without, it is
+    the pre-exec synchronous write."""
     from .dataset import _next_file_id
     os.makedirs(settings.fpath, exist_ok=True)
     base = os.path.join(settings.fpath,
                         f"mrtpu.sortrun.{_next_file_id()}.{seq}")
     kpath, vpath = base + ".k.npy", base + ".v.npy"
-    _save_col(fr.key, kpath)
-    _save_col(fr.value, vpath)
-    counters.add(wsize=fr.nbytes())
-    return _Run(kpath, vpath, len(fr), counters,
-                _col_kind(fr.key), _col_kind(fr.value))
+    nbytes = fr.nbytes()
+    key, value = fr.key, fr.value
+
+    def do_write():
+        _save_col(key, kpath)
+        _save_col(value, vpath)
+        counters.add(wsize=nbytes)
+
+    run = _Run(kpath, vpath, len(fr), counters,
+               _col_kind(key), _col_kind(value))
+    if writer is None:
+        do_write()
+    else:
+        run.pending = writer.submit(do_write)
+    return run
 
 
 def external_sorted_chunks(frames: Iterator[KVFrame], by: str,
@@ -179,18 +220,31 @@ def external_sorted_chunks(frames: Iterator[KVFrame], by: str,
 
     # pass 1: sort each frame (one vector sort via the shared column
     # argsort — a single order definition with the in-core path), spill
-    # as a run
+    # as a run.  With the background writer (exec/spill.py) the spill of
+    # run k-1 overlaps the sort of run k; its bounded pending queue caps
+    # unwritten frames, and every reader below passes the durability
+    # barrier before its first block
+    from ..exec import spill_bg_enabled
     from ..ops.sort import argsort_column
+    writer = None
+    if spill_bg_enabled():
+        from ..exec.spill import SpillWriter
+        writer = SpillWriter()
     runs: List[_Run] = []
     rowbytes = 16
-    for seq, fr in enumerate(frames):
-        col = fr.key if by == "key" else fr.value
-        order = argsort_column(col)
-        runs.append(_write_run(fr.take(order), settings, counters, seq))
-        if len(fr):
-            # size blocks for the WIDEST rows seen, or a fat-row run's
-            # refills would blow the budget the merge exists to bound
-            rowbytes = max(rowbytes, fr.nbytes() // len(fr))
+    try:
+        for seq, fr in enumerate(frames):
+            col = fr.key if by == "key" else fr.value
+            order = argsort_column(col)
+            runs.append(_write_run(fr.take(order), settings, counters,
+                                   seq, writer=writer))
+            if len(fr):
+                # size blocks for the WIDEST rows seen, or a fat-row
+                # run's refills would blow the budget the merge bounds
+                rowbytes = max(rowbytes, fr.nbytes() // len(fr))
+    finally:
+        if writer is not None:
+            writer.close()   # errors surface at the runs' barriers
 
     if not runs:
         return

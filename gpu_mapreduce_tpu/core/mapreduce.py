@@ -212,6 +212,7 @@ class MapReduce:
         # which path the last file map took ({"mode": "mesh"|"host", …},
         # parallel/ingest.py); None-mode until a file map runs
         self.last_ingest: dict = {"mode": None}
+        self._ingest_pool_obj = None   # shared ingest executor (lazy)
 
     # ------------------------------------------------------------------
     # settings passthrough (reference exposes them as public members)
@@ -330,6 +331,27 @@ class MapReduce:
     # ------------------------------------------------------------------
     # internal helpers
     # ------------------------------------------------------------------
+    def _ingest_pool(self):
+        """ONE ThreadPoolExecutor per MapReduce for mapstyle-2 ingest
+        (run_sinks / _run_tasks) instead of a fresh executor per call —
+        thread spin-up was per-shard overhead on the pipelined mesh
+        ingest.  Sized once at min(cpu, 16).  A weakref finalizer shuts
+        the pool down when the MR is collected, so a long-lived process
+        churning MapReduce objects never accumulates idle worker
+        threads (the executor must not anchor a reference cycle back to
+        self)."""
+        pool = self._ingest_pool_obj
+        if pool is None:
+            import os as _os
+            import weakref
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(
+                max_workers=max(1, min((_os.cpu_count() or 4), 16)),
+                thread_name_prefix="mrtpu-ingest")
+            self._ingest_pool_obj = pool
+            weakref.finalize(self, pool.shutdown, False)
+        return pool
+
     def _new_kv(self, name="kv") -> KeyValue:
         return KeyValue(self.settings, self.error, self.counters, name)
 
@@ -454,11 +476,10 @@ class MapReduce:
                 call(itask, payload, kv)
                 n += 1
             return n
-        import os
         from collections import deque
-        from concurrent.futures import ThreadPoolExecutor
 
-        nworkers = max(1, min((os.cpu_count() or 4), 16))
+        pool = self._ingest_pool()     # shared per-MR executor
+        nworkers = pool._max_workers
         window = 4 * nworkers
         inflight: deque = deque()      # (future, sink) in task order
         n = 0
@@ -468,21 +489,20 @@ class MapReduce:
             fut.result()               # propagate callback exceptions
             sink.replay(kv)
 
-        with ThreadPoolExecutor(nworkers) as pool:
-            try:
-                for itask, payload in enumerate(tasks):
-                    if len(inflight) >= window:
-                        drain_one()
-                    sink = _TaskSink()
-                    inflight.append(
-                        (pool.submit(call, itask, payload, sink), sink))
-                    n += 1
-                while inflight:
+        try:
+            for itask, payload in enumerate(tasks):
+                if len(inflight) >= window:
                     drain_one()
-            except BaseException:
-                for fut, _ in inflight:
-                    fut.cancel()
-                raise
+                sink = _TaskSink()
+                inflight.append(
+                    (pool.submit(call, itask, payload, sink), sink))
+                n += 1
+            while inflight:
+                drain_one()
+        except BaseException:
+            for fut, _ in inflight:
+                fut.cancel()
+            raise
         return n
 
     @_traced
@@ -575,11 +595,15 @@ class MapReduce:
             self.last_ingest = mesh_map_chunks(self, kv, names, per_file,
                                                sep, delta, call)
         else:
+            from ..exec import prefetch_iter
             chunks = (chunk for fname in names
                       for chunk in file_chunks(fname, per_file, sep, delta))
             # the serial chunk reader feeds the window lazily — under
-            # mapstyle 2 backpressure holds O(window) chunks, not all
-            self._run_tasks(kv, chunks, call)
+            # mapstyle 2 backpressure holds O(window) chunks, not all.
+            # exec/ prefetch overlaps the file read of chunk N+1 with
+            # chunk N's callback (MRTPU_PREFETCH extra chunks resident)
+            self._run_tasks(kv, prefetch_iter(chunks,
+                                              path="ingest.serial"), call)
             self.last_ingest = {"mode": "host"}
         n = self._finish_kv("map_chunks")
         self._time("map_chunks", t)
@@ -600,6 +624,11 @@ class MapReduce:
         itask = 0
         for fr in src_frames:
             if batch:
+                if not isinstance(fr, KVFrame):
+                    # the callback may add_frame(fr) into the new KV —
+                    # mark sharded frames so donation (exec/) never
+                    # deletes arrays the snapshot still references
+                    fr._shared = True
                 func(fr, kv, ptr)
                 itask += 1
             else:
@@ -1158,7 +1187,9 @@ class MapReduce:
         tracing is enabled (obs/) — an ``"ops"`` per-op aggregate over
         the span ring (count / total_s / byte sums per op name), plus a
         ``"plan"`` section with the compile-cache telemetry (plan cache
-        + bounded shuffle jit caches: hits/misses/evictions), plus —
+        + bounded shuffle jit caches: hits/misses/evictions), plus an
+        ``"exec"`` section with the async-overlap telemetry (per-path
+        overlap ratios + active knobs — doc/perf.md), plus —
         when the metrics registry is armed (obs/metrics.py) — a
         ``"metrics"`` section with the full labeled registry snapshot
         (op latency histograms, exchange byte counters, gauges)."""
@@ -1168,6 +1199,10 @@ class MapReduce:
             out["ops"] = self.tracer.stats()
         from ..plan.cache import cache_stats
         out["plan"] = cache_stats()
+        # overlap telemetry (exec/): per-path busy/wait seconds and the
+        # overlap ratio the mrtpu_overlap_ratio gauge exposes
+        from ..exec import exec_stats
+        out["exec"] = exec_stats()
         from ..obs import metrics as _metrics
         if _metrics.enabled():
             out["metrics"] = _metrics.snapshot()

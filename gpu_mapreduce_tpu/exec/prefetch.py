@@ -1,0 +1,116 @@
+"""Bounded background prefetch over an iterator.
+
+The ingest pipeline's producer/consumer split: a daemon thread pulls
+items from the source iterator (file reads + tokenizing callbacks — work
+that releases the GIL) up to ``depth`` items ahead of the consumer, so
+chunk N+1 is being read while chunk N's frames assemble/intern.  The
+queue gives backpressure both ways: the producer blocks when the
+consumer falls behind (peak residency ≈ depth+1 items, preserving the
+host path's lazy-window property), the consumer blocks only when the
+producer is genuinely slower.
+
+Order is the source order (single FIFO queue), so output is
+bit-identical to the unprefetched loop — the golden contract
+``tests/test_exec.py`` pins.  Producer exceptions re-raise in the
+consumer with their original traceback; an early consumer exit (break,
+exception) stops the producer promptly via a stop event.
+
+Telemetry: one ``exec.prefetch`` span per stream (emitted from the
+producer thread: items, busy seconds) and a cumulative
+:func:`..exec.note_overlap` record driving ``mrtpu_overlap_ratio{path}``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Iterable, Iterator, Optional
+
+_END = "end"
+_ITEM = "item"
+_ERR = "err"
+
+
+def prefetch_iter(src: Iterable, depth: Optional[int] = None,
+                  path: str = "ingest") -> Iterator:
+    """Iterate ``src`` through a background producer thread with a
+    bounded look-ahead of ``depth`` items (default: the MRTPU_PREFETCH
+    knob).  ``depth <= 0`` yields from ``src`` directly — the eager
+    golden path, zero threads."""
+    if depth is None:
+        from . import prefetch_depth
+        depth = prefetch_depth()
+    if depth <= 0:
+        yield from src
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    state = {"busy": 0.0, "items": 0, "inflight_max": 0}
+
+    def _put(msg) -> None:
+        # bounded put that gives up when the consumer is gone
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def producer() -> None:
+        err = None
+        try:
+            from ..obs import get_tracer
+            it = iter(src)
+            with get_tracer().span("exec.prefetch", cat="exec",
+                                   path=path, depth=depth) as sp:
+                while not stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
+                    except BaseException as e:   # callback/read failure
+                        err = e
+                        break
+                    state["busy"] += time.perf_counter() - t0
+                    state["items"] += 1
+                    state["inflight_max"] = max(state["inflight_max"],
+                                                q.qsize() + 1)
+                    _put((_ITEM, item))
+                sp.set(items=state["items"],
+                       busy_s=round(state["busy"], 6),
+                       error=type(err).__name__ if err is not None
+                       else "")
+        except BaseException as e:   # anything else: never strand the
+            err = err or e           # consumer without a sentinel
+        finally:
+            _put((_ERR, err) if err is not None else (_END, None))
+
+    t = threading.Thread(target=producer, daemon=True,
+                         name=f"mrtpu-prefetch-{path}")
+    t.start()
+    wait = 0.0
+    try:
+        while True:
+            t0 = time.perf_counter()
+            kind, payload = q.get()
+            wait += time.perf_counter() - t0
+            if kind == _END:
+                break
+            if kind == _ERR:
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+        # unblock a producer stuck on a full queue, then reap it
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=10.0)
+        from . import note_overlap
+        note_overlap(path, busy_s=state["busy"], wait_s=wait,
+                     items=state["items"])

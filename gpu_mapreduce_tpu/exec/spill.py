@@ -1,0 +1,144 @@
+"""Background spill writer with a durability barrier.
+
+``core/external.py`` pass 1 sorts each frame and spills it as a run;
+serially the op that triggered the spill blocks for the full write.
+This writer moves the write to a daemon thread so sort-of-run-k overlaps
+write-of-run-k-1, with two hard guarantees:
+
+* **durability barrier at run-handoff**: every submitted write returns a
+  :class:`Pending`; the merge's reader calls ``wait()`` before its first
+  read of that run, so a half-written run is unobservable.  A writer
+  failure re-raises at the barrier (never swallowed).
+* **no torn file under the final name**: callers write via
+  :func:`atomic_save` — tmp file + ``os.replace`` — so even a process
+  crash mid-write leaves only a ``*.tmp`` sibling, never a torn ``.npy``
+  a later run could load (the crash-during-spill test's contract).
+
+The submit queue is bounded (default 2 pending writes) so a fast sorter
+cannot pile unwritten frames in memory — the page-budget property the
+external machinery exists for.  Writer busy time feeds
+``note_overlap("spill", ...)`` / ``mrtpu_overlap_ratio{path="spill"}``;
+each write emits an ``exec.spill_write`` span.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def atomic_save(path: str, arr: np.ndarray, allow_pickle: bool = False
+                ) -> None:
+    """``np.save`` through a tmp sibling + ``os.replace`` so the final
+    path only ever holds a complete file.  ``path`` must already carry
+    its ``.npy`` suffix (saving through a file handle stops np.save
+    appending one to the tmp name)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.save(f, arr, allow_pickle=allow_pickle)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class Pending:
+    """Handle of one submitted write: ``wait()`` is the durability
+    barrier — returns once the write is fully on disk, re-raising any
+    writer-side failure."""
+
+    __slots__ = ("_done", "_error")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def wait(self) -> float:
+        """Block until durable; returns seconds spent blocked."""
+        t0 = time.perf_counter()
+        self._done.wait()
+        waited = time.perf_counter() - t0
+        if self._error is not None:
+            raise self._error
+        return waited
+
+
+class SpillWriter:
+    """One background writer thread (lazily started) with a bounded
+    pending queue.  Thread-safe: submits may come from any thread; the
+    writes themselves are serialized in submit order."""
+
+    def __init__(self, max_pending: int = 2, path: str = "spill"):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, max_pending))
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._path = path
+        self._closed = False
+
+    def submit(self, fn: Callable[[], None]) -> Pending:
+        """Enqueue ``fn`` (the write closure); blocks when max_pending
+        writes are already queued (backpressure — counted as foreground
+        wait, it IS time the sorter spent stalled on the writer).
+        Returns the :class:`Pending` barrier handle."""
+        if self._closed:
+            raise RuntimeError("SpillWriter is closed")
+        pending = Pending()
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"mrtpu-{self._path}-writer")
+                self._thread.start()
+        t0 = time.perf_counter()
+        self._q.put((fn, pending))
+        blocked = time.perf_counter() - t0
+        if blocked > 1e-4:
+            from . import note_overlap
+            note_overlap(self._path, wait_s=blocked)
+        return pending
+
+    def _run(self) -> None:
+        from ..obs import get_tracer
+        from . import note_overlap
+        tracer = get_tracer()
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, pending = item
+            t0 = time.perf_counter()
+            try:
+                with tracer.span("exec.spill_write", cat="exec",
+                                 path=self._path):
+                    fn()
+            except BaseException as e:
+                pending._error = e
+            finally:
+                pending._done.set()
+                note_overlap(self._path,
+                             busy_s=time.perf_counter() - t0, items=1)
+
+    def close(self) -> None:
+        """Drain every queued write and join the thread (idempotent).
+        The drain wall counts as foreground wait — without it a run
+        whose writes outlast its sorts would still read as "fully
+        overlapped" (the close blocks exactly as long as the writer is
+        behind).  Errors stay parked on their Pending handles — close
+        never raises; the reader's barrier is where failures surface."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            t = self._thread
+        if t is not None:
+            self._q.put(None)
+            t0 = time.perf_counter()
+            t.join(timeout=60.0)
+            blocked = time.perf_counter() - t0
+            if blocked > 1e-4:
+                from . import note_overlap
+                note_overlap(self._path, wait_s=blocked)

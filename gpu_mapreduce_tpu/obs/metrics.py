@@ -382,16 +382,30 @@ def _collect_plan(reg: MetricsRegistry) -> None:
                   cache=cname)
 
 
+def _collect_exec(reg: MetricsRegistry) -> None:
+    """Refresh the async-overlap gauges (exec/) at scrape time, so a
+    registry armed after an ingest still reads the cumulative ratios."""
+    from ..exec import exec_stats
+    g = reg.gauge("mrtpu_overlap_ratio",
+                  "fraction of background work hidden behind foreground "
+                  "work, per overlap path (1 = fully overlapped)",
+                  ("path",))
+    for path, rec in exec_stats()["overlap"].items():
+        g.set(rec["overlap_ratio"], path=path)
+
+
 def enable_metrics(flight: Optional[bool] = None) -> MetricsRegistry:
     """Wire the automatic feeds (idempotent): subscribe the span bridge
     to the process tracer (this enables tracing), register the Counters
-    and plan-cache collectors, and — unless ``flight=False`` or
-    ``MRTPU_FLIGHT=0`` — arm the flight recorder so a failing run
-    leaves a forensic artifact (obs/flight.py)."""
+    and plan-cache collectors plus the exec/ overlap collector, and —
+    unless ``flight=False`` or ``MRTPU_FLIGHT=0`` — arm the flight
+    recorder so a failing run leaves a forensic artifact
+    (obs/flight.py)."""
     global _ENABLED
     reg = get_registry()
     reg.register_collector(_collect_counters)
     reg.register_collector(_collect_plan)
+    reg.register_collector(_collect_exec)
     from .tracer import get_tracer
     get_tracer().subscribe_once(_bridge_emit)
     _ENABLED = True

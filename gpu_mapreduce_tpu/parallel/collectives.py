@@ -20,7 +20,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.frame import KVFrame
 from .mesh import mesh_axes, row_sharding, row_spec
 from .sharded import ShardedKV, shard_frame
-from .shuffle import exchange, _replace_kv_frames
+from .shuffle import exchange, free_if_donated, _replace_kv_frames
 
 
 def _ensure_sharded(backend, mr):
@@ -40,8 +40,14 @@ def gather_kv(backend, mr, nprocs: int):
     # shard i → i % n: the reference's exact funnel layout ("lo procs
     # recv from hi procs with same ID % numprocs",
     # src/mapreduce.cpp:919-928)
-    out = exchange(skv, ("fixed_mod", n),
-                   transport=mr.settings.all2all, counters=mr.counters)
+    try:
+        out = exchange(skv, ("fixed_mod", n),
+                       transport=mr.settings.all2all, counters=mr.counters)
+    except BaseException:
+        # donation may have consumed an installed frame: leave a clean
+        # empty dataset, not deleted buffers (shuffle.free_if_donated)
+        free_if_donated(mr.kv, skv)
+        raise
     _replace_kv_frames(mr.kv, out)
 
 

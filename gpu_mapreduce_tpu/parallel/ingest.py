@@ -72,12 +72,19 @@ def balance_by_bytes(names: Sequence[str], P: int):
 
 
 def run_sinks(payloads, call: Callable, threaded: bool = True,
-              base: int = 0):
+              base: int = 0, pool=None):
     """Run ``call(base+i, payload, sink)`` for every payload into
     private _TaskSink buffers; returns the sinks in task order.
     Threaded by default (the per-rank parallel read the reference gets
     from MPI); assembly order is by task index either way, so the
-    result is deterministic regardless of scheduling."""
+    result is deterministic regardless of scheduling.
+
+    ``pool``: a shared ThreadPoolExecutor (``MapReduce._ingest_pool`` —
+    one pool per MapReduce instead of a fresh executor per call); when
+    None a private pool capped at ``min(nworkers, len(payloads))`` is
+    built and torn down here (standalone callers)."""
+    import contextlib
+    from concurrent.futures import ThreadPoolExecutor
     from ..core.mapreduce import _TaskSink
     from ..obs import get_tracer
     sinks = [_TaskSink() for _ in payloads]
@@ -87,10 +94,16 @@ def run_sinks(payloads, call: Callable, threaded: bool = True,
             for i, p in enumerate(payloads):
                 call(base + i, p, sinks[i])
             return sinks
-        from concurrent.futures import ThreadPoolExecutor
-        nworkers = max(1, min((os.cpu_count() or 4), 16, len(payloads)))
-        with ThreadPoolExecutor(nworkers) as pool:
-            futs = [pool.submit(call, base + i, p, sinks[i])
+        # one submit/drain loop for both executors: a shared pool stays
+        # open (nullcontext), a private one tears down here
+        if pool is not None:
+            ctx = contextlib.nullcontext(pool)
+        else:
+            nworkers = max(1, min((os.cpu_count() or 4), 16,
+                                  len(payloads)))
+            ctx = ThreadPoolExecutor(nworkers)
+        with ctx as ex:
+            futs = [ex.submit(call, base + i, p, sinks[i])
                     for i, p in enumerate(payloads)]
             for f in futs:
                 f.result()   # propagate callback exceptions
@@ -254,68 +267,72 @@ def build_sharded(frames: List[KVFrame], mesh):
                      key_decode=ktables, value_decode=vtables)
 
 
+def _shard_sink_stream(shards_payloads, call: Callable, threaded: bool,
+                       pool):
+    """Generator of per-shard sink lists: ``run_sinks`` over each
+    shard's payloads in turn, with GLOBAL task numbering (cumulative
+    base).  This is the producer half the prefetch pipeline runs in its
+    background thread — read + tokenize shard N+1 while the consumer
+    assembles/interns shard N's frame."""
+    itask = 0
+    for payloads in shards_payloads:
+        sinks = run_sinks(payloads, call, threaded=threaded, base=itask,
+                          pool=pool)
+        itask += len(payloads)
+        yield sinks
+
+
+def _pooled_file_sink_stream(shards, call: Callable, pool):
+    """mapstyle-2 map_files producer: EVERY file's task submits to the
+    shared pool up front (the full cross-file parallelism the pre-exec
+    single run_sinks had — a P-shard mesh with ~1 file per shard must
+    not serialize its reads), then per-shard sink groups yield in task
+    order as their futures complete, so the consumer assembles shard N
+    while shards > N are still reading."""
+    from ..core.mapreduce import _TaskSink
+    from ..obs import get_tracer
+    names = [f for files in shards for f in files]
+    sinks = [_TaskSink() for _ in names]
+    with get_tracer().span("ingest.read", cat="ingest",
+                           ntasks=len(names), threaded=True):
+        futs = [pool.submit(call, i, name, sinks[i])
+                for i, name in enumerate(names)]
+        i = 0
+        for files in shards:
+            for f in futs[i:i + len(files)]:
+                f.result()   # propagate callback exceptions, task order
+            yield sinks[i:i + len(files)]
+            i += len(files)
+
+
 def mesh_map_files(mr, kv, names: Sequence[str], call: Callable) -> dict:
     """The mesh map_files path: per-shard ingest + dest-sharded intern.
     Returns the ingest stats record ({"mode": "mesh"|"host", ...});
     either way every callback has run exactly once and its pairs are in
-    ``kv``."""
+    ``kv``.
+
+    Shards pipeline through the exec/ prefetch: the reader/tokenizer
+    produce shard N+1's sinks while shard N's frame assembles (task ids
+    and replay order stay global file order — output is bit-identical
+    to the unprefetched path)."""
+    from ..exec import prefetch_iter
     from .mesh import mesh_axis_size
     P = mesh_axis_size(mr.backend.mesh)
     shards = [files for _, files, _ in balance_by_bytes(names, P)]
-    sinks = run_sinks(list(names), call,
-                      threaded=mr.settings.mapstyle == 2)
-    # regroup the per-file sinks by owning shard (contiguous slices)
     stats = {"mode": "mesh", "shards": P,
              "files_per_shard": [len(s) for s in shards]}
-    try:
-        frames = []
-        i = 0
-        for chunk in shards:
-            frames.append(_sink_frame(sinks[i:i + len(chunk)]))
-            i += len(chunk)
-        skv = build_sharded(frames, mr.backend.mesh)
-    except Unshardable as e:
-        for s in sinks:
-            s.replay(kv)
-        stats["mode"] = "host"
-        stats["fallback"] = str(e)[:200]
-        return stats
-    kv.add_frame(skv)
-    stats["rows_per_shard"] = skv.counts.tolist()
-    return stats
-
-
-def mesh_map_chunks(mr, kv, names: Sequence[str], per_file: int, sep: bytes,
-                    delta: int, call: Callable) -> dict:
-    """Mesh path for map_file_char/str: files balance across shards, each
-    file splits into its ~per_file chunks (utils.io.file_chunks — same
-    chunking as the host path, so callbacks see identical payloads and
-    task ids stay global file-then-chunk order).
-
-    Shards process ONE AT A TIME: a shard's raw chunk payloads are
-    generated, consumed into its frame, and released before the next
-    shard reads — peak raw-bytes residency is one shard's slice, not
-    the whole corpus (the host path's lazy-window property, kept;
-    r5 review)."""
-    from ..utils.io import file_chunks
-    from .mesh import mesh_axis_size
-    P = mesh_axis_size(mr.backend.mesh)
-    shards = [files for _, files, _ in balance_by_bytes(names, P)]
-    stats = {"mode": "mesh", "shards": P,
-             "files_per_shard": [len(s) for s in shards],
-             "chunks_per_shard": []}
+    threaded = mr.settings.mapstyle == 2
+    if threaded:
+        # all files in flight on the shared pool at once (cross-file
+        # parallelism), groups stream out in shard order
+        stream = _pooled_file_sink_stream(shards, call,
+                                          mr._ingest_pool())
+    else:
+        stream = _shard_sink_stream(shards, call, False, None)
     frames: List[KVFrame] = []
     done_sinks: List[list] = []   # per-shard sinks kept for fallback
     failed = None
-    itask = 0
-    for chunk_files in shards:
-        payloads = [c for fname in chunk_files
-                    for c in file_chunks(fname, per_file, sep, delta)]
-        stats["chunks_per_shard"].append(len(payloads))
-        sinks = run_sinks(payloads, call,
-                          threaded=mr.settings.mapstyle == 2, base=itask)
-        itask += len(payloads)
-        del payloads              # raw corpus bytes released per shard
+    for sinks in prefetch_iter(stream, path="ingest.files"):
         if failed is not None:
             for s in sinks:
                 s.replay(kv)
@@ -331,7 +348,81 @@ def mesh_map_chunks(mr, kv, names: Sequence[str], per_file: int, sep: bytes,
             for s in sinks:
                 s.replay(kv)
             frames, done_sinks = [], []
-    stats["ntasks"] = itask
+    if failed is None:
+        try:
+            skv = build_sharded(frames, mr.backend.mesh)
+        except Unshardable as e:
+            failed = str(e)[:200]
+            for ss in done_sinks:
+                for s in ss:
+                    s.replay(kv)
+    if failed is not None:
+        stats["mode"] = "host"
+        stats["fallback"] = failed
+        return stats
+    kv.add_frame(skv)
+    stats["rows_per_shard"] = skv.counts.tolist()
+    return stats
+
+
+def mesh_map_chunks(mr, kv, names: Sequence[str], per_file: int, sep: bytes,
+                    delta: int, call: Callable) -> dict:
+    """Mesh path for map_file_char/str: files balance across shards, each
+    file splits into its ~per_file chunks (utils.io.file_chunks — same
+    chunking as the host path, so callbacks see identical payloads and
+    task ids stay global file-then-chunk order).
+
+    Shards process ONE AT A TIME: a shard's raw chunk payloads are
+    generated, consumed into its frame, and released before the next
+    shard reads — peak raw-bytes residency is ~one shard's slice per
+    in-flight pipeline stage, not the whole corpus (the host path's
+    lazy-window property, kept; the exec/ prefetch pipeline holds at
+    most MRTPU_PREFETCH extra shards' tokenized sinks)."""
+    from ..exec import prefetch_iter
+    from ..utils.io import file_chunks
+    from .mesh import mesh_axis_size
+    P = mesh_axis_size(mr.backend.mesh)
+    shards = [files for _, files, _ in balance_by_bytes(names, P)]
+    stats = {"mode": "mesh", "shards": P,
+             "files_per_shard": [len(s) for s in shards],
+             "chunks_per_shard": []}
+    threaded = mr.settings.mapstyle == 2
+    pool = mr._ingest_pool() if threaded else None
+    counts = {"ntasks": 0}
+
+    def shard_payloads():
+        # producer side: the raw chunk bytes of one shard materialize,
+        # tokenize through the callbacks, and release before the next
+        # shard reads (run_sinks happens in _shard_sink_stream)
+        for chunk_files in shards:
+            payloads = [c for fname in chunk_files
+                        for c in file_chunks(fname, per_file, sep, delta)]
+            stats["chunks_per_shard"].append(len(payloads))
+            counts["ntasks"] += len(payloads)
+            yield payloads
+
+    frames: List[KVFrame] = []
+    done_sinks: List[list] = []   # per-shard sinks kept for fallback
+    failed = None
+    for sinks in prefetch_iter(
+            _shard_sink_stream(shard_payloads(), call, threaded, pool),
+            path="ingest.chunks"):
+        if failed is not None:
+            for s in sinks:
+                s.replay(kv)
+            continue
+        try:
+            frames.append(_sink_frame(sinks))
+            done_sinks.append(sinks)
+        except Unshardable as e:
+            failed = str(e)[:200]
+            for ss in done_sinks:
+                for s in ss:
+                    s.replay(kv)
+            for s in sinks:
+                s.replay(kv)
+            frames, done_sinks = [], []
+    stats["ntasks"] = counts["ntasks"]
     if failed is None:
         try:
             skv = build_sharded(frames, mr.backend.mesh)

@@ -222,29 +222,37 @@ PHASE2_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
                         name="shuffle.phase2")
 
 
-def _phase1_jit(mesh, dest):
+def _phase1_jit(mesh, dest, donate: bool = False):
     """Cache the jitted phase1 only for stable dest specs — a per-call
     user hash lambda would defeat reuse (and one-shot entries would
-    churn the LRU), so those build uncached (old behavior)."""
+    churn the LRU), so those build uncached (old behavior).
+
+    ``donate=True`` (exec/: MRTPU_DONATE) donates the key/value inputs —
+    the dest-sorted outputs are same-shape/dtype, so XLA aliases the
+    input buffers instead of materialising a second copy; the caller's
+    arrays are DELETED at dispatch and must be dead (the exchange's
+    input dataset is — it is replaced by the exchange output)."""
     if dest[0] == "hash" and dest[1] is not None:
-        return _phase1_build(mesh, dest)
+        return _phase1_build(mesh, dest, donate)
     return PHASE1_CACHE.get_or_build(
-        (mesh, dest), lambda: _phase1_build(mesh, dest))
+        (mesh, dest, donate), lambda: _phase1_build(mesh, dest, donate))
 
 
-def _phase1_build(mesh, dest):
+def _phase1_build(mesh, dest, donate: bool = False):
     nprocs = mesh_axis_size(mesh)
     dest_of = _dest_fn(dest, nprocs, mesh)
     spec = row_spec(mesh)
 
-    @jax.jit
     def phase1(key, value, count):
         f = functools.partial(_phase1, nprocs, dest_of)
         return jax.shard_map(
             f, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=(spec, spec, spec))(key, value, count)
 
-    return phase1
+    # phase 1 is shape-preserving (dest-sorted rows), so donation always
+    # aliases — the biggest win, on every aggregate/gather
+    from ..exec import donated_jit
+    return donated_jit(phase1, (0, 1) if donate else ())
 
 
 def phase2_shard_body(nprocs: int, transport: int, mesh, B: int,
@@ -285,17 +293,26 @@ def phase2_shard_body(nprocs: int, transport: int, mesh, B: int,
     return out_k, out_v, jnp.sum(counts_from)
 
 
-def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int):
+def _phase2_jit(mesh, transport: int, B: int, nrounds: int, cap_out: int,
+                donate: bool = False):
+    """``donate=True`` donates the dest-sorted skey/svalue (dead after
+    the exchange scatters them into the output blocks).  NEVER used for
+    the SPECULATIVE phase 2: a failed speculation re-runs phase 2 with
+    the same inputs, which donation would have deleted.  Callers only
+    pass donate=True when cap_out == cap (the caller checks) — the one
+    case the donation is byte-aliasable, so it never degrades to a
+    warned no-op."""
     return PHASE2_CACHE.get_or_build(
-        (mesh, transport, B, nrounds, cap_out),
-        lambda: _phase2_build(mesh, transport, B, nrounds, cap_out))
+        (mesh, transport, B, nrounds, cap_out, donate),
+        lambda: _phase2_build(mesh, transport, B, nrounds, cap_out,
+                              donate))
 
 
-def _phase2_build(mesh, transport: int, B: int, nrounds: int, cap_out: int):
+def _phase2_build(mesh, transport: int, B: int, nrounds: int, cap_out: int,
+                  donate: bool = False):
     nprocs = mesh_axis_size(mesh)
     spec = row_spec(mesh)
 
-    @jax.jit
     def phase2(skey, svalue, counts_local):
         def body(k, v, cl):
             out_k, out_v, _ = phase2_shard_body(
@@ -305,7 +322,8 @@ def _phase2_build(mesh, transport: int, B: int, nrounds: int, cap_out: int):
             body, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=(spec, spec))(skey, svalue, counts_local)
 
-    return phase2
+    from ..exec import donated_jit
+    return donated_jit(phase2, (0, 1) if donate else ())
 
 
 # speculative capacity cache (round 4, VERDICT r3 weak #5): composed
@@ -423,6 +441,25 @@ class ExchangeStats(metaclass=_ExchangeStatsMeta):
     last_bucket = _Attr(1)
 
 
+def free_if_donated(kv, skv) -> bool:
+    """After a FAILED exchange: if donation already consumed ``skv``'s
+    buffers and ``skv`` is an installed frame of ``kv``, free the
+    dataset — the next op then raises the clean "Cannot … without
+    completed KeyValue" MRError instead of a cryptic deleted-array
+    RuntimeError deep in XLA.  (Without donation a failed exchange
+    leaves the input intact and retryable, as before exec/.)  Returns
+    whether it freed."""
+    try:
+        if (skv is not None and any(f is skv for f in kv._frames)
+                and skv.key.is_deleted()):
+            kv.free()
+            kv.complete_done = False   # _require_kv now raises MRError
+            return True
+    except Exception:
+        pass
+    return False
+
+
 def exchange(skv: ShardedKV, dest, transport: int = 1,
              counters=None) -> ShardedKV:
     """Full ragged exchange: route every valid row to its dest shard.
@@ -447,10 +484,19 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
     mesh = skv.mesh
     nprocs = mesh_axis_size(mesh)
 
+    # exec/: donate dead buffers so XLA aliases instead of copying.
+    # phase 1's inputs (the pre-exchange dataset, replaced by the
+    # exchange output) and the definitive phase 2's inputs (the
+    # dest-sorted intermediates) are both dead after their use.  The
+    # eligibility rule (knob + not-shared + not-self-aliased) is
+    # exec.can_donate — ONE copy, shared with the fuser
+    from ..exec import can_donate
+    donate = can_donate(skv)
+
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
     bump_dispatch()
-    skey, svalue, counts_local = _phase1_jit(mesh, dest)(
+    skey, svalue, counts_local = _phase1_jit(mesh, dest, donate)(
         skv.key, skv.value, counts_dev)
     # speculative phase 2: enqueue with last time's caps BEFORE the
     # count-matrix pull, so the pull overlaps device work (async
@@ -498,7 +544,15 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
     else:
         sp.set(speculative=False)
         bump_dispatch()
-        out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out)(
+        # definitive phase 2: skey/svalue are dead after — donate them
+        # when the donation can actually alias (cap_out == cap; other
+        # sizes would be a warned no-op).  The speculative call above
+        # never donates: a failed speculation re-runs phase 2 on the
+        # same inputs
+        donate2 = (donate
+                   and cap_out == skey.shape[0] // max(nprocs, 1))
+        out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out,
+                                   donate=donate2)(
             skey, svalue, counts_local)
         with _SPEC_LOCK:
             _SPEC_CACHE[spec_key] = (B, nrounds, cap_out)
@@ -574,8 +628,13 @@ def aggregate_kv(backend, mr, hash_fn: Optional[Callable]):
     else:
         skv = frame  # already sharded
     t = Timer()
-    out = exchange(skv, ("hash", hash_fn), transport=mr.settings.all2all,
-                   counters=mr.counters)
+    try:
+        out = exchange(skv, ("hash", hash_fn),
+                       transport=mr.settings.all2all,
+                       counters=mr.counters)
+    except BaseException:
+        free_if_donated(kv, skv)
+        raise
     mr.counters.add(commtime=t.elapsed())
     # per-call stats (not the deprecated class attrs): concurrent MRs
     # each keep their own last_exchange

@@ -196,26 +196,43 @@ def _group_reduce_body(k, v, nrecv, gcap: int, out_kind: str,
     return ukey, segment_reduce_rows(sv, seg, valid, gcap, reduce_op), meta
 
 
+def _donate_argnums(donate: bool, aliasable_dim0: bool, out_kind: str,
+                    reduce_op, svalue) -> tuple:
+    """Which of (skey, svalue) to donate: only buffers whose donation
+    can actually alias an output of the same byte size (anything else
+    would be a warned no-op).  The key side always has a same-dtype
+    same-trailing-dims output; the value side does too EXCEPT for a
+    count reduce, whose output is 1-D int64 regardless of the value's
+    shape."""
+    if not (donate and aliasable_dim0):
+        return ()
+    if (out_kind == "kmv" or reduce_op != "count"
+            or (svalue.ndim == 1 and svalue.dtype.itemsize == 8)):
+        return (0, 1)
+    return (0,)
+
+
 def _fused_exchange_jit(mesh, transport: int, B: int, nrounds: int,
                         cap_out: int, out_kind: str,
-                        reduce_op: Optional[str]):
+                        reduce_op: Optional[str], donate_argnums=()):
     key = ("exchange", mesh, transport, B, nrounds, cap_out, out_kind,
-           reduce_op)
+           reduce_op, tuple(donate_argnums))
     return FUSED_CACHE.get_or_build(
         key, lambda: _fused_exchange_build(mesh, transport, B, nrounds,
-                                           cap_out, out_kind, reduce_op))
+                                           cap_out, out_kind, reduce_op,
+                                           donate_argnums))
 
 
 def _fused_exchange_build(mesh, transport, B, nrounds, cap_out, out_kind,
-                          reduce_op):
+                          reduce_op, donate_argnums=()):
     import jax
+    from ..exec import donated_jit
     from ..parallel.mesh import mesh_axis_size, row_spec
     from ..parallel.shuffle import phase2_shard_body
     nprocs = mesh_axis_size(mesh)
     spec = row_spec(mesh)
     nouts = 5 if out_kind == "kmv" else 3
 
-    @jax.jit
     def run(skey, svalue, counts_local):
         def body(k, v, cl):
             out_k, out_v, nrecv = phase2_shard_body(
@@ -226,7 +243,9 @@ def _fused_exchange_build(mesh, transport, B, nrounds, cap_out, out_kind,
             body, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=(spec,) * nouts)(skey, svalue, counts_local)
 
-    return run
+    # exec/: the dest-sorted phase-1 intermediates are dead after the
+    # fused program — donate the aliasable ones (MRTPU_DONATE)
+    return donated_jit(run, donate_argnums)
 
 
 def _compact_jit(mesh, n: int, narrs: int):
@@ -264,19 +283,21 @@ def _maybe_compact(mesh, gcap: int, gcounts, *arrs):
     return _compact_jit(mesh, new_gcap, len(arrs))(*arrs)
 
 
-def _fused_local_jit(mesh, out_kind: str, reduce_op: Optional[str]):
-    key = ("local", mesh, out_kind, reduce_op)
+def _fused_local_jit(mesh, out_kind: str, reduce_op: Optional[str],
+                     donate_argnums=()):
+    key = ("local", mesh, out_kind, reduce_op, tuple(donate_argnums))
     return FUSED_CACHE.get_or_build(
-        key, lambda: _fused_local_build(mesh, out_kind, reduce_op))
+        key, lambda: _fused_local_build(mesh, out_kind, reduce_op,
+                                        donate_argnums))
 
 
-def _fused_local_build(mesh, out_kind, reduce_op):
+def _fused_local_build(mesh, out_kind, reduce_op, donate_argnums=()):
     import jax
+    from ..exec import donated_jit
     from ..parallel.mesh import row_spec
     spec = row_spec(mesh)
     nouts = 5 if out_kind == "kmv" else 3
 
-    @jax.jit
     def run(key, value, counts):
         def body(k, v, c):
             return _group_reduce_body(k, v, c[0], k.shape[0], out_kind,
@@ -285,7 +306,10 @@ def _fused_local_build(mesh, out_kind, reduce_op):
             body, mesh=mesh, in_specs=(spec, spec, spec),
             out_specs=(spec,) * nouts)(key, value, counts)
 
-    return run
+    # exec/: the consumed KV is replaced by the grouped output right
+    # after (_install_kv) — donating lets the group layout reuse its
+    # buffers (ukey is same-size as key here: gcap == cap)
+    return donated_jit(run, donate_argnums)
 
 
 # ---------------------------------------------------------------------------
@@ -349,11 +373,13 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
     dest = ("hash", hash_fn)
 
     skv = _as_sharded(mr, frame)
+    from ..exec import can_donate
+    donate = can_donate(skv)
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
     t = Timer()
     bump_dispatch()
-    skey, svalue, counts_local = _phase1_jit(mesh, dest)(
+    skey, svalue, counts_local = _phase1_jit(mesh, dest, donate)(
         skv.key, skv.value, counts_dev)
     SyncStats.bump()   # the op's ONE round-trip: the count matrix
     counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
@@ -373,9 +399,13 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
         # eager speculative cache's right-sizing): recompile at fresh caps
         compiled.caps[gidx] = (B, nrounds, cap_out)
     bump_dispatch()
+    argnums = _donate_argnums(
+        donate, cap_out == skey.shape[0] // max(nprocs, 1), out_kind,
+        reduce_op, svalue)
     out = _fused_exchange_jit(mesh, transport, B, nrounds, cap_out,
-                              out_kind, reduce_op)(skey, svalue,
-                                                   counts_local)
+                              out_kind, reduce_op,
+                              donate_argnums=argnums)(skey, svalue,
+                                                      counts_local)
     meta = np.asarray(out[-1]).reshape(nprocs, 2)
     gcounts = meta[:, 0].astype(np.int32)
     vcounts = meta[:, 1].astype(np.int32)
@@ -436,16 +466,20 @@ def _exec_local_group(mr, stages, reduce_op, sp, frame):
     skv = frame
     mesh = skv.mesh
     nprocs = mesh_axis_size(mesh)
+    from ..exec import can_donate
+    donate = can_donate(skv)
+    cap = skv.key.shape[0] // nprocs   # before donation deletes the data
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
     bump_dispatch()
-    ukey, uval, meta = _fused_local_jit(mesh, "kv", reduce_op)(
+    argnums = _donate_argnums(donate, True, "kv", reduce_op, skv.value)
+    ukey, uval, meta = _fused_local_jit(mesh, "kv", reduce_op,
+                                        donate_argnums=argnums)(
         skv.key, skv.value, counts_dev)
     SyncStats.bump()
     gcounts = np.asarray(meta).reshape(nprocs, 2)[:, 0].astype(np.int32)
     ngroups = int(gcounts.sum())
-    ukey, uval = _maybe_compact(mesh, skv.key.shape[0] // nprocs,
-                                gcounts, ukey, uval)
+    ukey, uval = _maybe_compact(mesh, cap, gcounts, ukey, uval)
     skv_out = ShardedKV(mesh, ukey, uval, gcounts,
                         key_decode=skv.key_decode)
     if reduce_op == "first":
@@ -516,11 +550,22 @@ def execute_plan(mr, plan: Plan) -> None:
                 with tracer.span("plan.group", cat="plan", kind=kind,
                                  fused=True, nstages=n,
                                  reduce_op=rop or "") as sp:
-                    if kind == "exchange":
-                        _exec_exchange_group(mr, run, rop, compiled,
-                                             gidx, sp, frame)
-                    else:
-                        _exec_local_group(mr, run, rop, sp, frame)
+                    try:
+                        if kind == "exchange":
+                            _exec_exchange_group(mr, run, rop, compiled,
+                                                 gidx, sp, frame)
+                        else:
+                            _exec_local_group(mr, run, rop, sp, frame)
+                    except BaseException:
+                        # same contract as the eager exchange callers:
+                        # a failure after a donated dispatch must leave
+                        # a clean empty dataset (MRError on next op),
+                        # never frames holding deleted buffers
+                        from ..parallel.shuffle import free_if_donated
+                        kv = mr._kv_data
+                        if kv is not None:
+                            free_if_donated(kv, frame)
+                        raise
             i += n
             gidx += 1
         psp.set(ngroups=gidx,

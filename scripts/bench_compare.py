@@ -87,6 +87,11 @@ def record_metrics(rec: dict) -> Optional[dict]:
     if not isinstance(parsed, dict) or parsed.get("value") in (None, 0,
                                                                0.0):
         return None
+    if parsed.get("error"):
+        # an errored headline line is never a clean sample, whatever its
+        # value; transient notes from a clean run arrive under
+        # "warnings" instead (bench.py emit) and stay comparable
+        return None
     det = rec.get("detail") or parsed.get("detail") \
         or extract_detail(rec.get("tail", ""))
     m = {"pairs_per_sec": parsed["value"],

@@ -1,12 +1,13 @@
 #!/usr/bin/env bash
 # CI gate: the ROADMAP tier-1 suite plus fast subsets (fused-plan
-# equivalence, metrics/flight-recorder) so a regression there fails
-# loudly even when only the quick gate runs, and an ADVISORY bench
-# regression check (scripts/bench_compare.py) that prints its verdict
-# table into the CI log but never fails the build.
+# equivalence, metrics/flight-recorder, exec overlap/donation golden
+# equivalence) so a regression there fails loudly even when only the
+# quick gate runs, and an ADVISORY bench regression check
+# (scripts/bench_compare.py) that prints its verdict table into the CI
+# log but never fails the build.
 #
-#   scripts/ci.sh          # tier-1 + plan/metrics subsets + advisory gate
-#   scripts/ci.sh quick    # plan + metrics subsets only (~1 min)
+#   scripts/ci.sh          # tier-1 + plan/metrics/exec subsets + advisory
+#   scripts/ci.sh quick    # plan + metrics + exec subsets only (~1 min)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -22,6 +23,12 @@ run_metrics_subset() {
       -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_exec_subset() {
+  echo "== exec overlap/donation equivalence subset (fast) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_exec.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 bench_compare_advisory() {
   # advisory only: the verdict table lands in the CI log; a regression
   # (or a compare bug) must not fail the build — bench.py --gate is the
@@ -33,6 +40,7 @@ bench_compare_advisory() {
 if [ "${1:-}" = "quick" ]; then
   run_plan_subset
   run_metrics_subset
+  run_exec_subset
   bench_compare_advisory
   exit 0
 fi
@@ -49,4 +57,5 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
 
 run_plan_subset
 run_metrics_subset
+run_exec_subset
 bench_compare_advisory

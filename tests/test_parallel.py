@@ -215,9 +215,9 @@ def test_skewed_exchange_multi_round(mesh, all2all, monkeypatch):
     seen = {}
     orig = shuffle._phase2_jit
 
-    def spy(mesh_, transport, B, nrounds, cap_out):
+    def spy(mesh_, transport, B, nrounds, cap_out, **kw):
         seen["nrounds"] = nrounds
-        return orig(mesh_, transport, B, nrounds, cap_out)
+        return orig(mesh_, transport, B, nrounds, cap_out, **kw)
 
     monkeypatch.setattr(shuffle, "_phase2_jit", spy)
     shuffle._SPEC_CACHE.clear()   # order-independent: no speculation hit
@@ -451,9 +451,9 @@ def test_exchange_speculative_caps(mesh, monkeypatch):
     calls = []
     orig = shuffle._phase2_jit
 
-    def spy(mesh_, transport, B, nrounds, cap_out):
+    def spy(mesh_, transport, B, nrounds, cap_out, **kw):
         calls.append((B, nrounds, cap_out))
-        return orig(mesh_, transport, B, nrounds, cap_out)
+        return orig(mesh_, transport, B, nrounds, cap_out, **kw)
 
     monkeypatch.setattr(shuffle, "_phase2_jit", spy)
     shuffle._SPEC_CACHE.clear()
