@@ -44,6 +44,8 @@ from .core.frame import (BlockedMultivalue, KMVFrame, KVFrame,
                          iter_blocks)
 from .core.column import BytesColumn, DenseColumn, as_column
 from .core.runtime import MRError, Settings, global_counters
+from . import ft                      # fault tolerance (ft.schedule,
+#                                       ft.resume — doc/reliability.md)
 
 __version__ = "0.1.0"
 
@@ -51,5 +53,5 @@ __all__ = [
     "BlockedMultivalue", "iter_blocks",
     "MapReduce", "SerialBackend", "KeyValue", "KeyMultiValue",
     "KVFrame", "KMVFrame", "BytesColumn", "DenseColumn", "as_column",
-    "MRError", "Settings", "global_counters",
+    "MRError", "Settings", "global_counters", "ft",
 ]

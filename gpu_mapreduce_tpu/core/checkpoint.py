@@ -39,7 +39,12 @@ def save(mr, path: str) -> int:
     The save is atomic at directory granularity: frames + manifest are
     written to a temp sibling and swapped into place with rename, so an
     interrupted save can never leave a loadable manifest pointing at a
-    mix of old and new frames (a prior in-place overwrite could)."""
+    mix of old and new frames (a prior in-place overwrite could).  That
+    atomicity is also what makes the ft/ ``checkpoint.save`` retry
+    policy sound: a retried save re-runs the whole swap and can never
+    mix generations (callers wrap via ``ft.retry_call``)."""
+    from ..ft.inject import fault_point
+    fault_point("checkpoint.save", path=path)
     path = os.path.normpath(path)
     tmp = f"{path}.tmp.{os.getpid()}"
     shutil.rmtree(tmp, ignore_errors=True)

@@ -105,6 +105,8 @@ class _Run:
         note_overlap("spill", wait_s=waited)
 
     def _load(self, path: str, start: int, stop: int, kind: str) -> Column:
+        from ..ft.inject import fault_point
+        fault_point("spill.read", path=path)
         if kind == "dense":
             arr = np.load(path, mmap_mode="r")
             return DenseColumn(np.array(arr[start:stop]))
@@ -119,9 +121,19 @@ class _Run:
             return
         self.wait_ready()
         stop = min(self.pos + block_rows, self.n)
+        # ft/: a torn/transient block read retries under the spill.read
+        # budget — loads are idempotent (the run file is immutable once
+        # past the durability barrier above)
+        from ..ft.retry import retry_call
         self.buf = KVFrame(
-            self._load(self.kpath, self.pos, stop, self.kkind),
-            self._load(self.vpath, self.pos, stop, self.vkind))
+            retry_call("spill.read",
+                       lambda: self._load(self.kpath, self.pos, stop,
+                                          self.kkind),
+                       detail=self.kpath),
+            retry_call("spill.read",
+                       lambda: self._load(self.vpath, self.pos, stop,
+                                          self.vkind),
+                       detail=self.vpath))
         self.sur = sort_surrogate(self.buf.key if by == "key"
                                   else self.buf.value)
         self.counters.add(rsize=self.buf.nbytes())
@@ -196,8 +208,17 @@ def _write_run(fr: KVFrame, settings, counters, seq: int,
     key, value = fr.key, fr.value
 
     def do_write():
-        _save_col(key, kpath)
-        _save_col(value, vpath)
+        # ft/: transient write failures retry whole-run under the
+        # spill.write budget — atomic_save's tmp+replace makes a
+        # re-write idempotent (no torn final file can pre-exist)
+        from ..ft.inject import fault_point
+        from ..ft.retry import retry_call
+
+        def _write_both():
+            fault_point("spill.write", path=base)
+            _save_col(key, kpath)
+            _save_col(value, vpath)
+        retry_call("spill.write", _write_both, detail=base)
         counters.add(wsize=nbytes)
 
     run = _Run(kpath, vpath, len(fr), counters,

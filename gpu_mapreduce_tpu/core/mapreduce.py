@@ -174,6 +174,10 @@ class MapReduce:
         self.settings = Settings(**settings)
         self.settings.validate(self.error)
         self.counters = global_counters()
+        # fault-tolerance knobs (ft/): apply MRTPU_FAULTS / MRTPU_RETRY
+        # when they changed — two getenv+compare when they did not
+        from ..ft import configure_from_env as _ft_env
+        _ft_env()
         # tracing is process-global (obs/): `trace=path` turns on the
         # JSONL sink (the MRTPU_TRACE env var does the same without a
         # code change); `trace=True` enables the in-memory ring only
@@ -393,6 +397,11 @@ class MapReduce:
 
     def _op_stats(self, op: str, **kw):
         self._last_stats = {"op": op, **kw}
+        # ft/: one durable journal record per completed barrier op (and
+        # the programmatic auto-checkpoint trigger); a dict-check no-op
+        # when MRTPU_JOURNAL is unarmed
+        from ..ft.journal import note_op
+        note_op(self, op, kw.get("nkv", kw.get("nkmv")))
         if self.settings.verbosity:
             self.kv_stats(self.settings.verbosity, _op=op)
             if self.settings.verbosity >= 2 and self._op_snap is not None:
@@ -470,10 +479,17 @@ class MapReduce:
           backpressures both the payload producer (chunk readers) and
           buffered output — peak extra memory is O(window) tasks, never
           O(ntasks)."""
+        from ..ft.retry import ingest_task
+        onfault = self.settings.onfault
         if self.settings.mapstyle != 2:
             n = 0
             for itask, payload in enumerate(tasks):
-                call(itask, payload, kv)
+                # ft/: per-task fault points + retry/quarantine policy;
+                # attempts buffer into a private sink only when the
+                # policy is armed (zero-delta fast path otherwise), and
+                # a raw OSError wraps as MRError naming file/task
+                ingest_task(call, itask, payload, kv, onfault=onfault,
+                            private_sink=False)
                 n += 1
             return n
         from collections import deque
@@ -495,7 +511,8 @@ class MapReduce:
                     drain_one()
                 sink = _TaskSink()
                 inflight.append(
-                    (pool.submit(call, itask, payload, sink), sink))
+                    (pool.submit(ingest_task, call, itask, payload, sink,
+                                 onfault=onfault), sink))
                 n += 1
             while inflight:
                 drain_one()
@@ -518,6 +535,27 @@ class MapReduce:
         self._time("map", t)
         return n
 
+
+    def _find_inputs(self, files, recurse, readflag) -> List[str]:
+        """findfiles under the ft/ discovery policy: a failing path
+        surfaces as MRError naming it (never a raw OSError), or —
+        under onfault="skip" — quarantines and drops, exactly like the
+        same failure noticed one stage later at task-read time."""
+        from ..ft.retry import input_unreadable, quarantine_or_raise
+        if self.settings.onfault != "skip":
+            try:
+                return findfiles(files, bool(recurse), bool(readflag))
+            except OSError as e:
+                raise input_unreadable(e) from e
+        names: List[str] = []
+        for p in files:
+            try:
+                names.extend(findfiles([p], bool(recurse),
+                                       bool(readflag)))
+            except OSError as e:
+                quarantine_or_raise(e, p, "skip")
+        return names
+
     @_traced
     def map_files(self, files: Union[str, Sequence[str]], func: Callable,
                   ptr=None, self_flag: int = 0, recurse: int = 0,
@@ -536,7 +574,7 @@ class MapReduce:
         t = self._begin_op()
         if isinstance(files, str):
             files = [files]
-        names = findfiles(files, bool(recurse), bool(readflag))
+        names = self._find_inputs(files, recurse, readflag)
         kv = self._start_map(addflag)
         call = lambda itask, fname, sink: func(itask, fname, sink, ptr)
         if self._mesh_ingest_ok(addflag):
@@ -584,7 +622,7 @@ class MapReduce:
         t = self._begin_op()
         if isinstance(files, str):
             files = [files]
-        names = findfiles(files, bool(recurse), bool(readflag))
+        names = self._find_inputs(files, recurse, readflag)
         if not names:
             self.error.all("No files found for chunked map")
         per_file = max(1, nmap // max(1, len(names)))
@@ -596,13 +634,41 @@ class MapReduce:
                                                sep, delta, call)
         else:
             from ..exec import prefetch_iter
-            chunks = (chunk for fname in names
-                      for chunk in file_chunks(fname, per_file, sep, delta))
+            from ..ft.retry import (ingest_active, ingest_read,
+                                    input_unreadable)
+            onfault = self.settings.onfault
+
+            def chunk_stream():
+                # each file reads under the ft/ ingest.read policy:
+                # retry budget, MRError naming the file, quarantine-
+                # skip under onfault=skip (None = file skipped).  With
+                # the policy disarmed chunks stay LAZY per chunk (the
+                # host path's memory property) — a retry needs the
+                # whole file's chunks re-readable, so only the armed
+                # path materializes per file
+                for fname in names:
+                    if not ingest_active(onfault):
+                        it = file_chunks(fname, per_file, sep, delta)
+                        while True:
+                            try:
+                                chunk = next(it)
+                            except StopIteration:
+                                break
+                            except OSError as e:
+                                raise input_unreadable(e, fname) from e
+                            yield chunk
+                        continue
+                    chunks = ingest_read(
+                        lambda f=fname: list(file_chunks(f, per_file,
+                                                         sep, delta)),
+                        file=fname, onfault=onfault)
+                    if chunks is not None:
+                        yield from chunks
             # the serial chunk reader feeds the window lazily — under
             # mapstyle 2 backpressure holds O(window) chunks, not all.
             # exec/ prefetch overlaps the file read of chunk N+1 with
             # chunk N's callback (MRTPU_PREFETCH extra chunks resident)
-            self._run_tasks(kv, prefetch_iter(chunks,
+            self._run_tasks(kv, prefetch_iter(chunk_stream(),
                                               path="ingest.serial"), call)
             self.last_ingest = {"mode": "host"}
         n = self._finish_kv("map_chunks")
@@ -1167,10 +1233,14 @@ class MapReduce:
     @_traced
     def save(self, path: str) -> int:
         """Checkpoint the current KV or KMV to a directory; returns the
-        number of frames written (core/checkpoint.py)."""
+        number of frames written (core/checkpoint.py).  The save runs
+        under the ft/ ``checkpoint.save`` retry policy — the directory
+        swap is atomic, so a retried save can never mix generations."""
         self._flush_plan()
         from .checkpoint import save as _save
-        return _save(self, path)
+        from ..ft.retry import retry_call
+        return retry_call("checkpoint.save", lambda: _save(self, path),
+                          detail=path)
 
     @_traced
     def load(self, path: str) -> int:
@@ -1203,6 +1273,10 @@ class MapReduce:
         # overlap ratio the mrtpu_overlap_ratio gauge exposes
         from ..exec import exec_stats
         out["exec"] = exec_stats()
+        # fault-tolerance telemetry (ft/): retry outcomes per site,
+        # faults injected, quarantine accounting, journal progress
+        from ..ft import ft_stats
+        out["ft"] = ft_stats()
         from ..obs import metrics as _metrics
         if _metrics.enabled():
             out["metrics"] = _metrics.snapshot()

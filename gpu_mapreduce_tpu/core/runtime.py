@@ -74,6 +74,13 @@ class Settings:
     # the MRTPU_FUSE env var flips the default like MRTPU_MEMSIZE does
     fuse: int = field(default_factory=lambda: int(
         os.environ.get("MRTPU_FUSE", 0)))
+    # what a failed map input does after the ft/ retry budget is spent
+    # (no reference analog — the reference aborts on any read error):
+    # "fail" raises MRError, "retry" retries with a default budget even
+    # when MRTPU_RETRY is unset, "skip" quarantines the poisoned input
+    # and continues (records in mr.stats()["ft"] — doc/reliability.md)
+    onfault: str = field(default_factory=lambda: os.environ.get(
+        "MRTPU_ONFAULT", "fail"))
 
     def validate(self, error: Error):
         if self.memsize <= 0:
@@ -82,6 +89,8 @@ class Settings:
             error.all("Invalid mapstyle setting")
         if self.fuse not in (0, 1):
             error.all("Invalid fuse setting")
+        if self.onfault not in ("fail", "retry", "skip"):
+            error.all("Invalid onfault setting (fail, retry, or skip)")
         for a in (self.keyalign, self.valuealign):
             if a <= 0 or (a & (a - 1)):
                 error.all("Alignment setting must be power of 2")

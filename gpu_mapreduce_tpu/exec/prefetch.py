@@ -13,7 +13,11 @@ Order is the source order (single FIFO queue), so output is
 bit-identical to the unprefetched loop — the golden contract
 ``tests/test_exec.py`` pins.  Producer exceptions re-raise in the
 consumer with their original traceback; an early consumer exit (break,
-exception) stops the producer promptly via a stop event.
+exception) stops the producer promptly via a stop event.  The ft/
+retry policy composes cleanly: retries happen INSIDE the producer's
+task slots (``ft.retry.ingest_task`` under ``run_sinks``), so a
+recovered fault never reorders the stream — only an EXHAUSTED budget
+surfaces here, as the producer error the consumer re-raises.
 
 Telemetry: one ``exec.prefetch`` span per stream (emitted from the
 producer thread: items, busy seconds) and a cumulative

@@ -1,0 +1,104 @@
+"""Fault tolerance — injection, retry policy, journaled resume.
+
+The reference MR-MPI has NO fault tolerance: page files are scratch
+state and the only recovery is a full re-run (SURVEY.md §5,
+``core/checkpoint.py:3-5``).  This package is the policy layer that
+turns the existing durability building blocks (atomic checkpoints,
+``exec/spill.atomic_save``, the obs flight recorder) into survivable
+failures, in three pillars:
+
+* **deterministic fault injection** (:mod:`.inject`) — named fault
+  points at the real failure sites (``ingest.read``,
+  ``ingest.tokenize``, ``spill.write``, ``spill.read``,
+  ``shuffle.exchange``, ``checkpoint.save``), armed by a seeded
+  schedule (``MRTPU_FAULTS`` or :func:`schedule`), one bool check when
+  disarmed;
+* **retry / backoff policy** (:mod:`.retry`) — per-site transient-vs-
+  fatal classification, bounded retries with exponential backoff +
+  jitter (``MRTPU_RETRY``), the ``onfault`` dataset setting
+  (``fail`` | ``retry`` | ``skip``-with-quarantine), ``MRError`` +
+  flight-recorder dump on exhaustion;
+* **journaled auto-checkpoint + resume** (:mod:`.journal`) — an
+  append-only fsync'd op journal (``MRTPU_JOURNAL=dir``), automatic
+  checkpoints every ``MRTPU_CKPT_EVERY`` ops, and :func:`resume` /
+  OINK ``resume <dir>`` replaying an interrupted script from the last
+  durable checkpoint.
+
+The golden contract mirrors exec/: any fault schedule that the retry
+budget absorbs must leave output BYTE-IDENTICAL to the fault-free run
+(``tests/test_ft.py``), and with everything disarmed the whole package
+costs one bool check per site probe.
+
+Observability: ``ft.retry`` / ``ft.inject`` spans,
+``mrtpu_retries_total{site,outcome}`` /
+``mrtpu_faults_injected_total{site}`` /
+``mrtpu_quarantined_total{site}`` counters (obs/metrics.py collector),
+and the ``mr.stats()["ft"]`` section (:func:`ft_stats`).  Knob table
+and runbook: ``doc/reliability.md``.
+"""
+
+from __future__ import annotations
+
+from .inject import (SITES, FaultSpec, InjectedFault, InjectedFatal,
+                     clear_faults, counts as fault_counts, fault_point,
+                     parse_faults, schedule)
+from .retry import (budget, classify, ingest_task, parse_retry,
+                    quarantine_snapshot, retries_snapshot, retry_call,
+                    set_budget)
+from .journal import Journal, latest_checkpoint, read_journal, resume
+
+__all__ = [
+    "SITES", "FaultSpec", "InjectedFault", "InjectedFatal",
+    "schedule", "clear_faults", "fault_point", "parse_faults",
+    "fault_counts",
+    "retry_call", "set_budget", "budget", "classify", "parse_retry",
+    "ingest_task", "retries_snapshot", "quarantine_snapshot",
+    "Journal", "resume", "read_journal", "latest_checkpoint",
+    "configure_from_env", "ft_stats", "counters_snapshot", "reset",
+]
+
+
+def configure_from_env() -> None:
+    """Apply ``MRTPU_FAULTS`` / ``MRTPU_RETRY`` / ``MRTPU_JOURNAL``
+    when they changed — called from every ``MapReduce()`` construction
+    (three getenv+compare when nothing changed)."""
+    from . import inject as _inject, journal as _journal, retry as _retry
+    _inject.configure_from_env()
+    _retry.configure_from_env()
+    _journal.configure_from_env()
+
+
+def counters_snapshot() -> dict:
+    """The raw cumulative counters (the obs/metrics collector's pull
+    source): retries by (site, outcome), faults and quarantines by
+    site."""
+    from . import inject as _inject, retry as _retry
+    q = _retry.quarantine_snapshot()
+    return {"retries": _retry.retries_snapshot(),
+            "faults": _inject.counts(),
+            "quarantined": q["by_site"]}
+
+
+def ft_stats() -> dict:
+    """The ``mr.stats()["ft"]`` section: retry outcomes per site, faults
+    injected per site, quarantine accounting, journal progress."""
+    from . import inject as _inject, journal as _journal, retry as _retry
+    retries: dict = {}
+    for (site, outcome), n in _retry.retries_snapshot().items():
+        retries.setdefault(site, {})[outcome] = n
+    j = _journal.active()
+    return {"retries": retries,
+            "faults_injected": _inject.counts(),
+            "quarantined": _retry.quarantine_snapshot(),
+            "budgets": {s: _retry.budget(s) for s in SITES
+                        if _retry.budget(s)},
+            "journal": j.stats() if j is not None else None}
+
+
+def reset() -> None:
+    """Test isolation: disarm injection, drop budgets/counters/
+    quarantine, close the active journal."""
+    from . import inject as _inject, journal as _journal, retry as _retry
+    _inject.clear_faults()
+    _retry.reset()
+    _journal.reset()

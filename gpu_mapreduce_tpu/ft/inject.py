@@ -1,0 +1,263 @@
+"""Deterministic fault injection at named sites.
+
+The reference has no fault story at all — a failed read aborts the job
+(SURVEY.md §5).  Before a retry/resume layer can be trusted it must be
+*provoked* on demand: this module registers the real failure sites
+(:data:`SITES`) and arms them from a seeded schedule so a chaos run is
+reproducible bit-for-bit.
+
+Arming:
+
+* env — ``MRTPU_FAULTS="seed=7;site=ingest.read;rate=0.05;kind=oserror"``
+  (several specs separated by ``|``; ``site=*`` hits every registered
+  site, ``n=K`` caps a spec at K injected faults and ``after=K`` skips
+  a site's first K probes — both PER SITE, so wildcard specs stay
+  deterministic under thread interleaving);
+* code — :func:`schedule` with the same fields.
+
+Each spec owns a ``random.Random`` seeded from ``(seed, site)`` (via
+crc32, not the salted ``hash()``), so the k-th *probe* of a site draws
+the same verdict in every process — which probe faults does not depend
+on thread scheduling, only on how many times the site was reached.
+
+Disarmed cost: :func:`fault_point` is one module-bool check and
+returns — the acceptance criterion is "no measurable overhead with
+``MRTPU_FAULTS`` unset".
+
+Injected exceptions carry ``.ft_site`` (so the retry engine labels
+metrics by the true site even through wrapper frames) and subclass
+:class:`InjectedFault`, which the classifier treats as transient —
+except ``kind=fatal``, the kill switch the resume tests use.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+# the registered fault points — every name appears at exactly one real
+# failure site (see doc/reliability.md for the wiring table)
+SITES = ("ingest.read", "ingest.tokenize", "spill.write", "spill.read",
+         "shuffle.exchange", "checkpoint.save")
+
+
+class InjectedFault:
+    """Marker mixin: this exception was injected by ft/, not real."""
+
+
+class InjectedOSError(InjectedFault, OSError):
+    pass
+
+
+class InjectedTimeout(InjectedFault, TimeoutError):
+    pass
+
+
+class InjectedRuntimeError(InjectedFault, RuntimeError):
+    pass
+
+
+class InjectedFatal(InjectedFault, RuntimeError):
+    """kind=fatal: classified NON-retryable — kills the run through any
+    retry budget (the mid-run "crash" the journal/resume tests stage)."""
+
+
+_KINDS = {"oserror": InjectedOSError, "ioerror": InjectedOSError,
+          "timeout": InjectedTimeout, "runtime": InjectedRuntimeError,
+          "fatal": InjectedFatal}
+
+
+class FaultSpec:
+    """One armed schedule entry: which site(s), how often, what to raise."""
+
+    __slots__ = ("site", "rate", "kind", "seed", "max_faults", "after",
+                 "_rngs", "injected", "_probes", "_injected_by_site",
+                 "_from_env")
+
+    def __init__(self, site: str = "*", rate: float = 1.0,
+                 kind: str = "oserror", seed: int = 0,
+                 max_faults: Optional[int] = None, after: int = 0):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(one of {sorted(_KINDS)})")
+        if site != "*" and site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} "
+                             f"(registered: {SITES})")
+        self.site = site
+        self.rate = float(rate)
+        self.kind = kind
+        self.seed = int(seed)
+        self.max_faults = max_faults
+        self.after = int(after)      # skip the first `after` probes —
+        #                              places a fault mid-run on purpose
+        self._rngs: Dict[str, random.Random] = {}
+        self.injected = 0
+        # per-SITE probe/fault counters: a site="*" spec must stay
+        # deterministic per site — one shared counter would let thread
+        # interleaving (mapstyle-2 ingest vs the spill writer) move the
+        # fault between sites across runs, breaking the reproducibility
+        # contract; `after` and `n` therefore apply per site
+        self._probes: Dict[str, int] = {}
+        self._injected_by_site: Dict[str, int] = {}
+        self._from_env = False   # env respec replaces only env specs
+
+    def matches(self, site: str) -> bool:
+        return self.site in ("*", site)
+
+    def draw(self, site: str) -> bool:
+        """Deterministic verdict for the next probe of ``site``."""
+        probes = self._probes.get(site, 0) + 1
+        self._probes[site] = probes
+        if probes <= self.after:
+            return False
+        if self.max_faults is not None and \
+                self._injected_by_site.get(site, 0) >= self.max_faults:
+            return False
+        rng = self._rngs.get(site)
+        if rng is None:
+            # crc32, not hash(): hash() of str is salted per process and
+            # would break cross-run determinism
+            rng = self._rngs[site] = random.Random(
+                (self.seed << 32) ^ zlib.crc32(site.encode()))
+        if rng.random() < self.rate:
+            self._injected_by_site[site] = \
+                self._injected_by_site.get(site, 0) + 1
+            return True
+        return False
+
+
+_LOCK = threading.Lock()
+_SPECS: List[FaultSpec] = []
+_ARMED = False           # the fault_point fast-path check
+_ENV_APPLIED: Optional[str] = None   # last MRTPU_FAULTS string applied
+_COUNTS: Dict[str, int] = {}         # site → faults injected
+
+
+def schedule(site: str = "*", rate: float = 1.0, kind: str = "oserror",
+             seed: int = 0, max_faults: Optional[int] = None,
+             after: int = 0) -> FaultSpec:
+    """Arm one fault spec programmatically; returns it (its ``injected``
+    count is live).  ``ft.clear_faults()`` disarms everything."""
+    global _ARMED
+    spec = FaultSpec(site, rate, kind, seed, max_faults, after)
+    with _LOCK:
+        _SPECS.append(spec)
+        _ARMED = True
+    return spec
+
+
+def clear_faults() -> None:
+    """Disarm every spec (programmatic and env-sourced) and drop the
+    injection counts; the next :func:`configure_from_env` re-reads the
+    environment from scratch."""
+    global _ARMED, _ENV_APPLIED
+    with _LOCK:
+        _SPECS.clear()
+        _COUNTS.clear()
+        _ARMED = False
+        _ENV_APPLIED = None
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def armed_for(site: str) -> bool:
+    """Whether any armed spec can hit ``site`` — callers that pay a
+    structural cost to be injectable (the ingest paths' buffered
+    attempts, materialized chunk lists) check per site, so arming
+    spill-only chaos never changes ingest behavior."""
+    if not _ARMED:
+        return False
+    with _LOCK:
+        return any(s.matches(site) for s in _SPECS)
+
+
+def parse_faults(text: str) -> List[FaultSpec]:
+    """``"seed=7;site=ingest.read;rate=0.05;kind=oserror"`` → specs.
+    ``|`` separates independent specs; ``site`` may list several sites
+    comma-separated (one spec each, sharing the other fields)."""
+    specs: List[FaultSpec] = []
+    for part in text.split("|"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = {}
+        for kv in part.split(";"):
+            kv = kv.strip()
+            if not kv:
+                continue
+            if "=" not in kv:
+                raise ValueError(f"malformed MRTPU_FAULTS field {kv!r}")
+            k, v = kv.split("=", 1)
+            fields[k.strip()] = v.strip()
+        sites = fields.pop("site", "*").split(",")
+        kw = {"rate": float(fields.pop("rate", 1.0)),
+              "kind": fields.pop("kind", "oserror"),
+              "seed": int(fields.pop("seed", 0)),
+              "after": int(fields.pop("after", 0))}
+        if "n" in fields:
+            kw["max_faults"] = int(fields.pop("n"))
+        if fields:
+            raise ValueError(f"unknown MRTPU_FAULTS fields "
+                             f"{sorted(fields)}")
+        for s in sites:
+            specs.append(FaultSpec(site=s.strip(), **kw))
+    return specs
+
+
+def configure_from_env() -> None:
+    """Apply ``MRTPU_FAULTS`` if it changed since last look (called from
+    every ``MapReduce()`` construction — cheap: one getenv + compare).
+    A malformed value warns and stays disarmed, never crashes the run
+    (the utils.env contract)."""
+    global _ARMED, _ENV_APPLIED
+    import os
+    import sys
+    raw = os.environ.get("MRTPU_FAULTS", "")
+    if raw == (_ENV_APPLIED or ""):
+        return
+    try:
+        specs = parse_faults(raw) if raw else []
+    except (ValueError, TypeError) as e:
+        print(f"MRTPU_FAULTS ignored: {e!r}", file=sys.stderr)
+        specs = []
+    with _LOCK:
+        # env respec replaces only env-sourced arming; programmatic
+        # specs are the caller's to clear
+        _SPECS[:] = [s for s in _SPECS if not s._from_env]
+        for s in specs:
+            s._from_env = True
+            _SPECS.append(s)
+        _ARMED = bool(_SPECS)
+        _ENV_APPLIED = raw
+
+
+def fault_point(site: str, **detail) -> None:
+    """Probe a registered site: raise the scheduled fault or return.
+    THE hot-path entry — one bool check when disarmed."""
+    if not _ARMED:
+        return
+    with _LOCK:
+        for spec in _SPECS:
+            if spec.matches(site) and spec.draw(site):
+                spec.injected += 1
+                _COUNTS[site] = _COUNTS.get(site, 0) + 1
+                exc_cls, kind = _KINDS[spec.kind], spec.kind
+                break
+        else:
+            return
+    exc = exc_cls(f"injected {kind} fault at {site}"
+                  + (f" ({detail})" if detail else ""))
+    exc.ft_site = site
+    from ..obs import get_tracer
+    with get_tracer().span("ft.inject", cat="ft", site=site, kind=kind):
+        raise exc
+
+
+def counts() -> Dict[str, int]:
+    """{site: faults injected so far} (process-cumulative)."""
+    with _LOCK:
+        return dict(_COUNTS)

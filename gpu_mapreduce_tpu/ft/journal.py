@@ -1,0 +1,401 @@
+"""Journaled auto-checkpoint + resume.
+
+The reference's only recovery story is re-running the job from scratch
+(``core/checkpoint.py:3-5``).  With atomic dataset checkpoints
+(``core/checkpoint.py``) already in place, this module adds the policy
+layer that makes a crash at ANY point survivable:
+
+* an **append-only JSONL op journal** under ``MRTPU_JOURNAL=dir``
+  (``journal.jsonl``): a ``begin`` record capturing the script's lines,
+  one ``cmd`` record per completed script command, one ``op`` record
+  per completed MapReduce barrier op (forensics), and a ``ckpt`` record
+  per durable checkpoint set.  Every append is flushed + fsync'd BEFORE
+  the run proceeds, and every record is written only AFTER the thing it
+  describes completed — so the journal never claims work that did not
+  durably happen.
+* **auto-checkpointing** every ``MRTPU_CKPT_EVERY`` completed commands
+  (default 5): every named MR saves through ``core/checkpoint.py``'s
+  atomic directory swap into ``dir/ckpt-<seq>/<name>``; the ``ckpt``
+  record lands only after ALL saves succeeded, so a crash mid-
+  checkpoint leaves the previous record as the durable truth.  Non-
+  script (programmatic) runs auto-checkpoint the reporting MapReduce
+  into the single ``dir/auto`` slot every N ops instead.
+* **resume** — ``ft.resume(dir)`` in code or the OINK builtin
+  ``resume <dir>``: re-runs the recorded script lines, SKIPPING the
+  first K command executions (K = the last checkpoint's sequence
+  number; builtins like ``variable``/``set``/``mr``/``jump`` re-execute
+  so loop variables and control flow reproduce exactly), restores every
+  named MR from the checkpoint at the skip boundary, then continues
+  live — journaling into the same directory, so a resumed run is
+  itself resumable.
+
+Everything here is plain files: resume needs no state from the crashed
+process, which is what "kill -9 at any point" safety means.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core.runtime import MRError
+
+_FILE = "journal.jsonl"
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional["Journal"] = None
+
+
+class Journal:
+    """One append-only journal + its checkpoint directory."""
+
+    def __init__(self, dir: str, script_mode: bool = False,
+                 every: Optional[int] = None):
+        from ..utils.env import env_knob
+        self.dir = dir
+        os.makedirs(dir, exist_ok=True)
+        self.path = os.path.join(dir, _FILE)
+        self._f = open(self.path, "a")
+        self.script_mode = script_mode
+        self.every = max(1, every if every is not None
+                         else env_knob("MRTPU_CKPT_EVERY", int, 5))
+        self.cmd_seq = 0          # completed script-command executions
+        self.op_seq = 0           # completed MR barrier ops
+        self.nckpt = 0
+        self._since = 0           # cmds (or ops) since last checkpoint
+        self._wlock = threading.Lock()
+
+    # -- append -------------------------------------------------------------
+    def append(self, rec: dict, sync: bool = True) -> None:
+        """Durable append: the record is on disk when this returns (the
+        whole design rests on records never leading their facts).
+        ``sync=False`` skips the fsync — for FORENSIC records nothing
+        replays from (op records), so an iterative workload doesn't
+        serialize on one disk flush per barrier op."""
+        line = json.dumps(rec, default=str)
+        with self._wlock:
+            self._f.write(line + "\n")
+            self._f.flush()
+            if sync:
+                os.fsync(self._f.fileno())
+
+    def begin(self, lines: List[str], name: str) -> None:
+        # command numbering is PER SCRIPT: resume applies a ckpt's seq
+        # as a skip count within the last begin's lines, so a second
+        # run_string on the same interpreter must restart the count or
+        # its checkpoints would over-skip the replay
+        self.cmd_seq = 0
+        self._since = 0
+        self.append({"kind": "begin", "name": name, "lines": list(lines),
+                     "pid": os.getpid(),
+                     "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())})
+
+    def cmd_done(self, command: str) -> None:
+        self.cmd_seq += 1
+        self.append({"kind": "cmd", "seq": self.cmd_seq, "cmd": command})
+
+    def note_op(self, op: str, **extra) -> None:
+        # forensics only — resume replays cmd/ckpt records, never ops,
+        # so these flush without the per-record fsync
+        self.op_seq += 1
+        self.append({"kind": "op", "op_seq": self.op_seq, "op": op,
+                     **extra}, sync=False)
+
+    # -- checkpointing ------------------------------------------------------
+    def maybe_checkpoint(self, obj) -> None:
+        """Script-mode trigger: checkpoint all named MRs every
+        ``every`` completed commands."""
+        self._since += 1
+        if self._since >= self.every:
+            self.checkpoint(obj)
+
+    def checkpoint(self, obj) -> bool:
+        """Save every named MR (atomic per-MR via checkpoint.save's
+        directory swap); the ``ckpt`` record is appended only after ALL
+        saves succeeded.  An MR in the open() cross-add state cannot
+        checkpoint — the whole round is skipped and retried after the
+        next command.  Returns whether a checkpoint landed."""
+        import dataclasses
+        from ..core.checkpoint import save as _cksave
+        from .retry import retry_call
+        seq = self.cmd_seq
+        reldir = f"ckpt-{seq:05d}"
+        cdir = os.path.join(self.dir, reldir)
+        mrs: Dict[str, dict] = {}
+        try:
+            for name in sorted(obj.named):
+                mr = obj.named[name]
+                path = os.path.join(cdir, name)
+                retry_call("checkpoint.save",
+                           lambda m=mr, p=path: _cksave(m, p),
+                           detail=path)
+                mrs[name] = {"path": f"{reldir}/{name}",
+                             "settings": dataclasses.asdict(mr.settings)}
+        except Exception:
+            # un-checkpointable right now (open() state, exhausted save
+            # retries, disk error or injected fault of ANY kind with no
+            # budget armed): drop the partial set and try again next
+            # trigger — a failed OPTIONAL checkpoint must never kill
+            # the run it protects (KeyboardInterrupt/SystemExit pass)
+            shutil.rmtree(cdir, ignore_errors=True)
+            return False
+        self.append({"kind": "ckpt", "seq": seq, "mrs": mrs})
+        self.nckpt += 1
+        self._since = 0
+        self._gc(keep=2)
+        return True
+
+    def auto_checkpoint(self, mr) -> None:
+        """Programmatic-run trigger (no script): every ``every`` ops,
+        checkpoint the reporting MR into the single ``auto`` slot."""
+        self._since += 1
+        if self._since < self.every:
+            return
+        from ..core.checkpoint import save as _cksave
+        from .retry import retry_call
+        path = os.path.join(self.dir, "auto")
+        try:
+            retry_call("checkpoint.save", lambda: _cksave(mr, path),
+                       detail=path)
+        except Exception:
+            return      # open()-state MR / disk / injection: next time
+        self.append({"kind": "auto_ckpt", "op_seq": self.op_seq,
+                     "path": "auto"})
+        self.nckpt += 1
+        self._since = 0
+
+    def _gc(self, keep: int) -> None:
+        """Bound disk: drop all but the ``keep`` NEWEST ckpt dirs — by
+        mtime, not name: begin() restarts the seq numbering per script,
+        so a re-run in the same journal dir writes low-numbered dirs
+        that must outlive a previous run's stale high-numbered ones."""
+        try:
+            dirs = sorted((d for d in os.listdir(self.dir)
+                           if d.startswith("ckpt-")),
+                          key=lambda d: os.path.getmtime(
+                              os.path.join(self.dir, d)))
+            for d in dirs[:-keep]:
+                shutil.rmtree(os.path.join(self.dir, d),
+                              ignore_errors=True)
+        except OSError:
+            pass
+
+    def stats(self) -> dict:
+        return {"dir": self.dir, "cmds": self.cmd_seq, "ops": self.op_seq,
+                "ckpts": self.nckpt, "every": self.every}
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# process-global arming (the MapReduce._op_stats hook reads this)
+# ---------------------------------------------------------------------------
+
+def active() -> Optional[Journal]:
+    return _ACTIVE
+
+
+def activate(journal: Optional[Journal]) -> Optional[Journal]:
+    """Install ``journal`` as the process-global op-record sink;
+    returns the previous one (callers restore it)."""
+    global _ACTIVE
+    with _LOCK:
+        prev, _ACTIVE = _ACTIVE, journal
+    return prev
+
+
+def from_env(script_mode: bool = False) -> Optional[Journal]:
+    """A Journal for ``MRTPU_JOURNAL`` (activated), or None.  Each call
+    makes a FRESH Journal — arming is per run, not cached, so two
+    scripts in one process each journal their own lines.  A previous
+    PROGRAMMATIC journal (the one the env auto-armed, held by nobody)
+    is closed; a script's journal is left open — that script still
+    appends through its own handle (concurrent scripts sharing one
+    journal dir are unsupported for resume either way — journal per
+    world, doc/reliability.md)."""
+    dir = os.environ.get("MRTPU_JOURNAL", "")
+    if not dir:
+        return None
+    j = Journal(dir, script_mode=script_mode)
+    prev = activate(j)
+    if prev is not None and prev is not j and not prev.script_mode:
+        prev.close()
+    return j
+
+
+_ENV_APPLIED: Optional[str] = None
+
+
+def configure_from_env() -> None:
+    """Auto-arm the PROGRAMMATIC journal from ``MRTPU_JOURNAL`` (called
+    via ``ft.configure_from_env`` on every MapReduce construction) —
+    the settings.md contract that the env var alone arms journaling
+    must hold for non-script runs too.  Script runs arm their own
+    journal in ``OinkScript.__init__`` (before any MR exists), which
+    this never replaces."""
+    global _ENV_APPLIED
+    raw = os.environ.get("MRTPU_JOURNAL", "")
+    if raw == (_ENV_APPLIED or ""):
+        return
+    _ENV_APPLIED = raw
+    with _LOCK:
+        active_now = _ACTIVE
+    if raw and active_now is None:
+        try:
+            from_env(script_mode=False)
+        except OSError as e:
+            # unusable journal dir: warn-and-disarm like every other
+            # ft env knob — never crash the MapReduce constructor
+            import sys
+            print(f"MRTPU_JOURNAL ignored: {e!r}", file=sys.stderr)
+    elif not raw and active_now is not None and not active_now.script_mode:
+        reset()     # env cleared: disarm the env-armed programmatic one
+
+
+def note_op(mr, op: str, n=None) -> None:
+    """Called from ``MapReduce._op_stats`` after every completed barrier
+    op — one dict check when no journal is armed."""
+    j = _ACTIVE
+    if j is None:
+        return
+    j.note_op(op, **({"n": int(n)} if isinstance(n, (int, float))
+                     else {}))
+    if not j.script_mode:
+        j.auto_checkpoint(mr)
+
+
+# ---------------------------------------------------------------------------
+# reading + resume
+# ---------------------------------------------------------------------------
+
+def read_journal(dir: str) -> List[dict]:
+    path = os.path.join(dir, _FILE)
+    try:
+        with open(path) as f:
+            out = []
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    out.append(json.loads(ln))
+                except ValueError:
+                    break    # torn final line from a crash mid-append
+            return out
+    except FileNotFoundError:
+        raise MRError(f"no journal under {dir!r}")
+
+
+def plan_resume(dir: str) -> dict:
+    """Read the journal and compute the replay plan: the recorded
+    script lines, the number of command executions to skip, and the
+    checkpoint record to restore at the skip boundary."""
+    recs = read_journal(dir)
+    begin_i = max((i for i, r in enumerate(recs)
+                   if r.get("kind") == "begin"), default=None)
+    if begin_i is None:
+        raise MRError(f"journal under {dir!r} has no begin record "
+                      f"(nothing to resume)")
+    begin = recs[begin_i]
+    tail = recs[begin_i:]
+    ckpt = None
+    done = 0
+    for r in tail:
+        if r.get("kind") == "ckpt":
+            ckpt = r
+        elif r.get("kind") == "cmd":
+            done = max(done, int(r.get("seq", 0)))
+    return {"lines": begin["lines"], "name": begin.get("name", "<resume>"),
+            "skip": int(ckpt["seq"]) if ckpt else 0, "ckpt": ckpt,
+            "cmds_done": done}
+
+
+def restore_mrs(obj, ckpt: dict, dir: str) -> None:
+    """Rebuild every named MR of a ``ckpt`` record into ``obj``:
+    settings reapplied, dataset loaded from the checkpoint directory."""
+    for name, meta in ckpt.get("mrs", {}).items():
+        mr = obj.named.get(name)
+        if mr is None:
+            mr = obj.create_mr()
+            obj.name_mr(name, mr)
+        settings = dict(meta.get("settings", {}))
+        if settings:
+            mr.set(**settings)
+        mr.load(os.path.join(dir, meta["path"]))
+
+
+def resume_into(script, dir: str) -> None:
+    """Drive an (ideally fresh) OinkScript through the resume plan:
+    skip the already-checkpointed command executions, restore the MRs,
+    continue live with journaling re-armed into the same directory."""
+    plan = plan_resume(dir)
+    if getattr(script, "_ft_journal", None) is not None:
+        script._ft_journal.close()   # replace an env-armed journal
+    j = Journal(dir, script_mode=True)
+    activate(j)
+    j.cmd_seq = plan["skip"]      # seq continues from the restore point
+    j.append({"kind": "resume", "from_seq": plan["skip"],
+              "cmds_done_before_crash": plan["cmds_done"],
+              "pid": os.getpid()})
+    script._ft_journal = j
+    script._ft_pending_begin = None   # never shadow the real begin
+    script._ft_skip = plan["skip"]
+    script._ft_restore = (plan["ckpt"], dir) if plan["ckpt"] else None
+    script._ft_resuming = True
+    try:
+        script._run_lines(plan["lines"], plan["name"])
+    finally:
+        script._ft_resuming = False
+    # the replay completed: disarm.  Commands an ENCLOSING script might
+    # run after its `resume <dir>` line are not part of the recorded
+    # begin, so journaling them would corrupt the seq numbering a later
+    # resume skips by — resume is a whole-script operation
+    # (doc/reliability.md); a crash DURING the replay leaves the
+    # journal armed and resumable, which is the state that matters
+    j.close()
+    script._ft_journal = None
+    if active() is j:
+        activate(None)
+
+
+def resume(dir: str, comm=None, screen=False, logfile: Optional[str] = None):
+    """``ft.resume(dir)``: build a fresh interpreter and replay the
+    journal's script from its last durable checkpoint.  Returns the
+    finished OinkScript (named MRs inspectable by the caller)."""
+    from ..oink.script import OinkScript
+    s = OinkScript(comm=comm, screen=screen, logfile=logfile)
+    resume_into(s, dir)
+    return s
+
+
+def latest_checkpoint(dir: str) -> Optional[str]:
+    """Path of the newest durable checkpoint under a journal dir: the
+    programmatic ``auto`` slot, or the last script ``ckpt`` set's
+    directory.  None when no checkpoint record exists."""
+    recs = read_journal(dir)
+    for r in reversed(recs):
+        if r.get("kind") == "auto_ckpt":
+            return os.path.join(dir, r.get("path", "auto"))
+        if r.get("kind") == "ckpt":
+            return os.path.join(dir, f"ckpt-{int(r['seq']):05d}")
+    return None
+
+
+def reset() -> None:
+    """Test isolation: close + drop the active journal and the env
+    cache (the next configure_from_env re-reads from scratch)."""
+    global _ACTIVE, _ENV_APPLIED
+    with _LOCK:
+        if _ACTIVE is not None:
+            _ACTIVE.close()
+        _ACTIVE = None
+        _ENV_APPLIED = None

@@ -1,0 +1,441 @@
+"""Retry / backoff policy engine for the registered fault sites.
+
+One transient ``OSError`` from a single input file, a torn spill read
+or a flaky device transfer used to abort the whole pipeline.  This
+module gives every registered site (:data:`..ft.inject.SITES`) a
+bounded-retry policy:
+
+* **budgets** — ``MRTPU_RETRY="ingest.read=3,spill.read=2"`` (or a bare
+  ``MRTPU_RETRY=3`` for every site), or :func:`set_budget`.  Budget 0
+  (the default) means the call runs bare — no wrapper frames, no
+  behavior change.
+* **classification** — transient (worth retrying: OS/timeout/connection
+  errors, injected faults) vs fatal (semantic errors, ``MRError``,
+  ``FileNotFoundError`` — a missing file will still be missing on the
+  4th attempt, and ``kind=fatal`` injections).
+* **backoff** — exponential with jitter: ``base * 2^k``, capped, scaled
+  by a seeded jitter in [0.5, 1.0) (``MRTPU_RETRY_BACKOFF`` base
+  seconds, ``MRTPU_RETRY_BACKOFF_MAX`` cap; tests monkeypatch
+  :data:`_sleep`).
+* **exhaustion** — raises ``MRError`` naming the site, attempt count
+  and last error, chained to the original.  The failing attempt chain
+  is one ``ft.retry`` obs span (site / attempts / outcome), so the
+  flight recorder's dump shows exactly which site gave up.
+
+Retries count into ``mrtpu_retries_total{site,outcome}`` (outcome:
+``retry`` per re-attempt, ``recovered`` on late success, ``exhausted``
+/ ``fatal`` on the final disposition) via the obs/metrics collector.
+
+The ingest task wrapper (:func:`ingest_task`) additionally implements
+the ``onfault`` dataset setting: attempts buffer into a private
+``_TaskSink`` (a retry can therefore never duplicate or reorder the
+pairs a partial attempt already emitted), raw ``OSError`` from a map
+callback wraps into an ``MRError`` naming the file/shard/task, and
+``onfault="skip"`` quarantines the poisoned input instead of failing
+the run.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..core.runtime import MRError
+from . import inject
+
+_sleep = time.sleep          # monkeypatch hook for backoff-timing tests
+
+_LOCK = threading.Lock()
+_BUDGETS: Dict[str, int] = {}        # site → max retries (not attempts)
+_DEFAULT_BUDGET = 0                  # applies to sites not listed
+_ENV_APPLIED: Optional[str] = None
+_ENV_SITES: set = set()              # budget keys set by MRTPU_RETRY —
+_ENV_DEFAULT = False                 # an env respec replaces only these
+#                                      (programmatic set_budget state
+#                                      survives, mirroring inject specs)
+# (site, outcome) → count; outcomes: retry / recovered / exhausted / fatal
+_RETRIES: Dict[tuple, int] = {}
+_QUARANTINE: List[dict] = []         # skip-with-record entries
+_QUARANTINE_KEEP = 64                # records kept for stats (count is exact)
+_NQUAR: Dict[str, int] = {}          # site → total quarantined
+_JITTER = random.Random(0xF7A11)     # seeded: backoff is reproducible
+
+
+def set_budget(site: str, retries: int) -> None:
+    """Programmatic twin of ``MRTPU_RETRY``: allow ``retries``
+    re-attempts at ``site`` (``"*"`` sets the default for every site).
+    Survives later MRTPU_RETRY changes (those replace only env-sourced
+    budgets)."""
+    global _DEFAULT_BUDGET, _ENV_DEFAULT
+    if site != "*" and site not in inject.SITES:
+        # same loud contract as parse_faults: a typo'd site silently
+        # disarming the protection the operator thinks is on would be
+        # the worst possible failure mode for this knob
+        raise ValueError(f"unknown retry site {site!r} "
+                         f"(registered: {inject.SITES})")
+    with _LOCK:
+        if site == "*":
+            _DEFAULT_BUDGET = int(retries)
+            _ENV_DEFAULT = False
+        else:
+            _BUDGETS[site] = int(retries)
+            _ENV_SITES.discard(site)
+
+
+def budget(site: str) -> int:
+    with _LOCK:
+        return _BUDGETS.get(site, _DEFAULT_BUDGET)
+
+
+def parse_retry(text: str) -> Dict[str, int]:
+    """``"ingest.read=3,spill.read=2"`` (or bare ``"3"``) → budgets.
+    Unknown sites raise (→ one stderr warning via configure_from_env),
+    like parse_faults — never a silently-inert typo."""
+    out: Dict[str, int] = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            site, n = part.split("=", 1)
+            site = site.strip()
+            if site != "*" and site not in inject.SITES:
+                raise ValueError(f"unknown retry site {site!r} "
+                                 f"(registered: {inject.SITES})")
+            out[site] = int(n)
+        else:
+            out["*"] = int(part)
+    return out
+
+
+def configure_from_env() -> None:
+    """Apply ``MRTPU_RETRY`` when it changed (one getenv + compare per
+    MapReduce construction); malformed values warn and disarm.  A
+    respec replaces only ENV-sourced budgets — programmatic
+    ``set_budget`` state survives (same contract as inject specs)."""
+    global _ENV_APPLIED, _DEFAULT_BUDGET, _ENV_DEFAULT
+    import os
+    import sys
+    raw = os.environ.get("MRTPU_RETRY", "")
+    if raw == (_ENV_APPLIED or ""):
+        return
+    try:
+        budgets = parse_retry(raw) if raw else {}
+    except (ValueError, TypeError) as e:
+        print(f"MRTPU_RETRY ignored: {e!r}", file=sys.stderr)
+        budgets = {}
+    with _LOCK:
+        for site in _ENV_SITES:
+            _BUDGETS.pop(site, None)
+        _ENV_SITES.clear()
+        if _ENV_DEFAULT:
+            _DEFAULT_BUDGET = 0
+            _ENV_DEFAULT = False
+        if "*" in budgets:
+            _DEFAULT_BUDGET = budgets.pop("*")
+            _ENV_DEFAULT = True
+        _BUDGETS.update(budgets)
+        _ENV_SITES.update(budgets)
+        _ENV_APPLIED = raw
+
+
+def _backoff(attempt: int) -> float:
+    """Delay before retry ``attempt`` (0-based): exponential, capped,
+    jittered into [0.5, 1.0)× so retry storms decorrelate."""
+    from ..utils.env import env_knob
+    base = env_knob("MRTPU_RETRY_BACKOFF", float, 0.05)
+    cap = env_knob("MRTPU_RETRY_BACKOFF_MAX", float, 2.0)
+    return min(cap, base * (2.0 ** attempt)) * (0.5 + 0.5 * _JITTER.random())
+
+
+def classify(site: str, exc: BaseException) -> str:
+    """``"transient"`` (retry may help) or ``"fatal"`` (it will not)."""
+    if isinstance(exc, inject.InjectedFatal):
+        return "fatal"
+    if isinstance(exc, inject.InjectedFault):
+        return "transient"
+    if isinstance(exc, MRError):
+        return "fatal"
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError,
+                        NotADirectoryError)):
+        # deterministically absent input: retrying burns the budget on
+        # an error the satellite contract wraps as MRError instead
+        return "fatal"
+    if isinstance(exc, (OSError, TimeoutError, ConnectionError)):
+        return "transient"
+    return "fatal"
+
+
+def _count(site: str, outcome: str) -> None:
+    with _LOCK:
+        _RETRIES[(site, outcome)] = _RETRIES.get((site, outcome), 0) + 1
+
+
+def retry_call(site: str, fn: Callable, *, detail: str = "",
+               retryable: Optional[Callable[[BaseException], bool]] = None,
+               budget_override: Optional[int] = None):
+    """Run ``fn()`` under ``site``'s retry policy.  Budget 0 (the
+    disarmed default) calls straight through — no wrapper frames, no
+    behavior delta.  ``retryable``: extra per-call veto (e.g. "the
+    exchange's donated buffers are already consumed").
+    ``budget_override``: a caller-computed budget (the ingest paths'
+    onfault-derived default) instead of the site's configured one."""
+    b = budget(site) if budget_override is None else budget_override
+    if b <= 0:
+        return fn()
+    try:
+        return fn()
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as first:
+        return _retry_tail(site, fn, first, b, detail, retryable)
+
+
+def _retry_tail(site: str, fn: Callable, first: BaseException, b: int,
+                detail: str, retryable) -> object:
+    """The slow path after a first failure: classification + bounded
+    backoff retries, all under ONE ``ft.retry`` span."""
+    from ..obs import get_tracer
+    with get_tracer().span("ft.retry", cat="ft", site=site,
+                           detail=detail) as sp:
+        e = first
+        attempt = 0
+        while True:
+            s = getattr(e, "ft_site", site)   # injected faults know theirs
+            if classify(s, e) == "fatal" or \
+                    (retryable is not None and not retryable(e)):
+                _count(s, "fatal")
+                sp.set(site=s, outcome="fatal", attempts=attempt)
+                raise e
+            if attempt >= b:
+                _count(s, "exhausted")
+                sp.set(site=s, outcome="exhausted", attempts=attempt,
+                       last_error=type(e).__name__)
+                err = MRError(
+                    f"ft: {s} retry budget exhausted after "
+                    f"{attempt + 1} attempts"
+                    + (f" ({detail})" if detail else "")
+                    + f": {e!r}")
+                err.ft_site = s    # downstream quarantine keeps the site
+                raise err from e
+            _sleep(_backoff(attempt))
+            _count(s, "retry")
+            attempt += 1
+            try:
+                out = fn()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e2:
+                e = e2
+                continue
+            _count(s, "recovered")
+            sp.set(site=s, outcome="recovered", attempts=attempt)
+            return out
+
+
+# ---------------------------------------------------------------------------
+# the ingest task wrapper: onfault policy + MRError wrapping + quarantine
+# ---------------------------------------------------------------------------
+
+def quarantine(site: str, **record) -> None:
+    """Record one skipped (poisoned) input; counted exactly, last
+    :data:`_QUARANTINE_KEEP` records kept for ``mr.stats()["ft"]``."""
+    with _LOCK:
+        _NQUAR[site] = _NQUAR.get(site, 0) + 1
+        _QUARANTINE.append({"site": site, **record})
+        del _QUARANTINE[:-_QUARANTINE_KEEP]
+    from ..obs import get_tracer
+    get_tracer().annotate(ft_quarantined=record.get("task"))
+
+
+def ingest_active(onfault: str = "fail") -> bool:
+    """Whether the ingest paths need the buffered-attempt wrapper
+    (injection armed FOR an ingest site, any ingest retry budget, or a
+    non-default ``onfault`` policy) — False is the zero-delta fast
+    path.  Per-site arming matters: spill-only chaos must not cost the
+    chunk readers their lazy-window memory property."""
+    return (onfault != "fail"
+            or inject.armed_for("ingest.read")
+            or inject.armed_for("ingest.tokenize")
+            or budget("ingest.read") > 0 or budget("ingest.tokenize") > 0)
+
+
+def _ingest_budget(onfault: str) -> int:
+    b = max(budget("ingest.read"), budget("ingest.tokenize"))
+    if b == 0 and onfault == "retry":
+        b = 2       # onfault=retry without an explicit budget: default 2
+    return b
+
+
+def input_unreadable(e: OSError, file=None) -> "MRError":
+    """THE discovery-failure wrapper, one copy (map_files/_map_chunks
+    findfiles + the mesh paths' balance_by_bytes): an OSError from
+    input discovery becomes an MRError naming the file — worded by
+    what actually happened, not assumed to be 'not found'."""
+    name = file if file is not None else getattr(e, "filename", None)
+    if name is None and e.args and isinstance(e.args[0], str):
+        name = e.args[0]      # findfiles raises FileNotFoundError(path)
+    err = MRError(f"map input file {name!r} unreadable: {e!r}")
+    err.ft_site = "ingest.read"
+    return err
+
+
+def quarantine_or_raise(e: OSError, file, onfault: str,
+                        shard=None) -> bool:
+    """Discovery-stage disposition (findfiles / balance_by_bytes): the
+    same policy a task-time failure gets — quarantine under
+    ``onfault="skip"`` (returns True: caller drops the file), else
+    raise the wrapping MRError.  Which stage notices a bad input must
+    not decide whether the run survives it."""
+    if onfault == "skip" and _skippable(e):
+        quarantine("ingest.read", file=file, shard=shard,
+                   error=repr(e)[:200])
+        return True
+    raise input_unreadable(e, file) from e
+
+
+def _skippable(e: BaseException) -> bool:
+    """What onfault='skip' may quarantine: per-input failures (I/O
+    errors, poisoned-input semantic errors, exhausted budgets) — NOT
+    the injected kill switch (InjectedFatal exists to kill the run
+    through any policy; the resume runbook depends on it) and not
+    resource exhaustion."""
+    return not isinstance(e, (inject.InjectedFatal, MemoryError))
+
+
+def _where(itask, fname, shard) -> str:
+    out = f"task {itask}"
+    if shard is not None:
+        out += f", shard {shard}"
+    if fname is not None:
+        out += f", file {fname!r}"
+    return out
+
+
+def ingest_task(call: Callable, itask: int, payload, out, *,
+                onfault: str = "fail", shard: Optional[int] = None,
+                private_sink: bool = True):
+    """Run one map task (``call(itask, payload, sink)``) under the
+    ingest fault policy.
+
+    ``out`` is the task's own ``_TaskSink`` (``private_sink=True`` —
+    the run_sinks / mapstyle-2 paths) or the live ``KeyValue``
+    (``private_sink=False`` — the serial ``_run_tasks`` path).  Either
+    way every ATTEMPT buffers into a fresh private sink that is only
+    published on success, so a retried task can never duplicate pairs a
+    failed attempt already emitted, and task-order (hence output
+    byte-identity) is untouched.
+
+    A raw ``OSError`` escaping the callback wraps into an ``MRError``
+    naming the file, shard and task id (the "missing input file
+    surfaces as a raw OSError from deep inside the pipeline" fix);
+    ``onfault="skip"`` quarantines the input instead and the task
+    contributes nothing."""
+    fname = payload if isinstance(payload, str) else None
+    if not ingest_active(onfault):
+        try:
+            return call(itask, payload, out)
+        except OSError as e:
+            if fname is None:
+                raise   # not a file task: the callback's own OSError
+                #         (ENOSPC writing ITS output…) keeps its type
+            raise MRError(f"map input {_where(itask, fname, shard)} "
+                          f"failed: {e}") from e
+    from ..core.mapreduce import _TaskSink
+    where = _where(itask, fname, shard)
+
+    def attempt():
+        inject.fault_point("ingest.read", task=itask)
+        tmp = _TaskSink()
+        call(itask, payload, tmp)
+        inject.fault_point("ingest.tokenize", task=itask)
+        return tmp
+
+    b = _ingest_budget(onfault)
+    try:
+        try:
+            tmp = attempt()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as first:
+            if b <= 0:
+                # no retry policy configured: the original error
+                # propagates untouched — never reported as an
+                # "exhausted budget" that was never armed
+                raise
+            tmp = _retry_tail("ingest.read", attempt, first, b,
+                              where, None)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:
+        if onfault == "skip" and _skippable(e):
+            quarantine(getattr(e, "ft_site", "ingest.read"), task=itask,
+                       shard=shard, file=fname, error=repr(e)[:200])
+            return None
+        if isinstance(e, OSError) and fname is not None:
+            raise MRError(f"map input {where} failed: {e}") from e
+        raise
+    if private_sink:
+        out._calls[:] = tmp._calls
+    else:
+        tmp.replay(out)
+    return None
+
+
+def ingest_read(fn: Callable, *, file: Optional[str] = None,
+                onfault: str = "fail", shard: Optional[int] = None):
+    """Wrap a host-side input READ that runs outside a task callback
+    (the chunked readers' ``file_chunks`` materialization): same
+    policy as :func:`ingest_task` — retry budget, MRError naming the
+    file, quarantine under ``onfault="skip"`` (returns None)."""
+    def attempt():
+        inject.fault_point("ingest.read", file=file)
+        return fn()
+
+    try:
+        b = _ingest_budget(onfault) if ingest_active(onfault) else 0
+        if b <= 0:
+            return attempt() if inject.armed_for("ingest.read") else fn()
+        return retry_call("ingest.read", attempt,
+                          detail=str(file or ""), budget_override=b)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except BaseException as e:
+        if onfault == "skip" and _skippable(e):
+            quarantine(getattr(e, "ft_site", "ingest.read"), shard=shard,
+                       file=file, error=repr(e)[:200])
+            return None
+        if isinstance(e, OSError):
+            raise input_unreadable(e, file) from e
+        raise
+
+
+# ---------------------------------------------------------------------------
+# stats / isolation
+# ---------------------------------------------------------------------------
+
+def retries_snapshot() -> Dict[tuple, int]:
+    with _LOCK:
+        return dict(_RETRIES)
+
+
+def quarantine_snapshot() -> dict:
+    with _LOCK:
+        return {"count": sum(_NQUAR.values()), "by_site": dict(_NQUAR),
+                "records": list(_QUARANTINE)}
+
+
+def reset() -> None:
+    """Test isolation: budgets, counters, quarantine, env cache."""
+    global _DEFAULT_BUDGET, _ENV_APPLIED, _ENV_DEFAULT
+    with _LOCK:
+        _BUDGETS.clear()
+        _RETRIES.clear()
+        _QUARANTINE.clear()
+        _NQUAR.clear()
+        _ENV_SITES.clear()
+        _DEFAULT_BUDGET = 0
+        _ENV_APPLIED = None
+        _ENV_DEFAULT = False

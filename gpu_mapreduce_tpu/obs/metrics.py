@@ -382,6 +382,50 @@ def _collect_plan(reg: MetricsRegistry) -> None:
                   cache=cname)
 
 
+_FT_LOCK = threading.Lock()
+# last ft/ counter values already synced into the registry: the ft
+# counters are process-cumulative and may predate enable_metrics, so
+# the collector delta-syncs at scrape time (exact regardless of when
+# the registry armed; the lock keeps concurrent scrapes from double-
+# counting a delta)
+_FT_SEEN: Dict[str, dict] = {"retries": {}, "faults": {},
+                             "quarantined": {}}
+
+
+def _collect_ft(reg: MetricsRegistry) -> None:
+    """Refresh the fault-tolerance counters from ft/'s cumulative
+    sources: mrtpu_retries_total{site,outcome},
+    mrtpu_faults_injected_total{site}, mrtpu_quarantined_total{site}."""
+    from ..ft import counters_snapshot
+    snap = counters_snapshot()
+    specs = (("retries", "mrtpu_retries_total",
+              "ft/ retry engine outcomes per site "
+              "(retry/recovered/exhausted/fatal)", ("site", "outcome")),
+             ("faults", "mrtpu_faults_injected_total",
+              "faults injected by the ft/ chaos schedule", ("site",)),
+             ("quarantined", "mrtpu_quarantined_total",
+              "poisoned map inputs skipped under onfault=skip",
+              ("site",)))
+    with _FT_LOCK:
+        for field, name, help, labels in specs:
+            c = reg.counter(name, help, labels)
+            seen = _FT_SEEN[field]
+            for key, n in snap[field].items():
+                d = n - seen.get(key, 0)
+                if d < 0:
+                    # the source went backwards — only ft.reset() does
+                    # that, so everything now counted is NEW since the
+                    # reset: inc the full n (staying monotonic) rather
+                    # than silently dropping post-reset events until
+                    # counts exceed their pre-reset values
+                    d = n
+                if d > 0:
+                    lab = dict(zip(labels, key if isinstance(key, tuple)
+                                   else (key,)))
+                    c.inc(d, **lab)
+                seen[key] = n
+
+
 def _collect_exec(reg: MetricsRegistry) -> None:
     """Refresh the async-overlap gauges (exec/) at scrape time, so a
     registry armed after an ingest still reads the cumulative ratios."""
@@ -406,6 +450,7 @@ def enable_metrics(flight: Optional[bool] = None) -> MetricsRegistry:
     reg.register_collector(_collect_counters)
     reg.register_collector(_collect_plan)
     reg.register_collector(_collect_exec)
+    reg.register_collector(_collect_ft)
     from .tracer import get_tracer
     get_tracer().subscribe_once(_bridge_emit)
     _ENABLED = True
@@ -434,6 +479,9 @@ def reset() -> None:
     global _ENABLED
     _ENABLED = False
     get_registry().reset()
+    with _FT_LOCK:
+        for d in _FT_SEEN.values():
+            d.clear()
 
 
 # -- feed points ------------------------------------------------------------

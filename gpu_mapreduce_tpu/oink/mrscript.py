@@ -231,5 +231,5 @@ class MRScriptDispatch:
         if len(a) != 2:
             raise MRError("Illegal MR object set command")
         key = a[0]
-        val = a[1] if key == "fpath" else int(a[1])
+        val = a[1] if key in ("fpath", "onfault") else int(a[1])
         mr.set(**{key: val})

@@ -43,9 +43,11 @@ class ObjectManager:
     """Holds named MRs, temporaries, descriptors, and MR defaults."""
 
     # settings the `set` script command may override (doc: oinkdoc/set.txt;
-    # `fuse` is ours — plan/ fused pipelines, doc/plan.md)
+    # `fuse` is ours — plan/ fused pipelines, doc/plan.md — as is
+    # `onfault`, the ft/ failed-map-input policy, doc/reliability.md)
     MR_SETTINGS = ("verbosity", "timer", "memsize", "outofcore", "minpage",
-                   "maxpage", "freepage", "zeropage", "fpath", "fuse")
+                   "maxpage", "freepage", "zeropage", "fpath", "fuse",
+                   "onfault")
 
     def __init__(self, comm=None):
         self.comm = comm

@@ -64,6 +64,19 @@ class OinkScript:
         self._labelstr = ""
         self._jump_skip = False
         self._jump_to: Optional[tuple] = None   # (filename-or-SELF, lines)
+        # ft/ journaling + resume state (doc/reliability.md): a journal
+        # armed by MRTPU_JOURNAL records every completed command and
+        # auto-checkpoints the named MRs; resume replays the recorded
+        # lines, skipping the first _ft_skip command EXECUTIONS
+        # (builtins re-run so loop variables and jumps reproduce), then
+        # restores the MRs from _ft_restore and continues live
+        from ..ft.journal import from_env as _ft_from_env
+        self._ft_journal = _ft_from_env(script_mode=True)
+        self._ft_skip = 0
+        self._ft_restore: Optional[tuple] = None   # (ckpt record, dir)
+        self._ft_resuming = False
+        self._ft_depth = 0
+        self._ft_pending_begin: Optional[tuple] = None
 
     def _nprocs(self) -> int:
         # query the backend directly — creating (and leaking until the
@@ -106,10 +119,27 @@ class OinkScript:
     def run_file(self, filename: str):
         with open(filename) as f:
             lines = f.read().splitlines()
-        self._run_lines(lines, filename)
+        self._run_script(lines, filename)
 
     def run_string(self, text: str):
-        self._run_lines(text.splitlines(), "<string>")
+        self._run_script(text.splitlines(), "<string>")
+
+    def _run_script(self, lines: List[str], name: str):
+        """Top-level driver: with a journal armed, the outermost run
+        stages its lines as the pending ``begin`` record — written
+        LAZILY at the first completed command, so a script that only
+        runs builtins (e.g. the one-line `resume <dir>` runbook entry
+        with MRTPU_JOURNAL still pointing at the same directory) never
+        writes a bogus begin that would shadow the real script's on the
+        next resume.  Nested runs (``include``) don't re-begin."""
+        j = self._ft_journal
+        if j is not None and self._ft_depth == 0 and not self._ft_resuming:
+            self._ft_pending_begin = (list(lines), name)
+        self._ft_depth += 1
+        try:
+            self._run_lines(lines, name)
+        finally:
+            self._ft_depth -= 1
 
     def _run_lines(self, lines: List[str], filename: str):
         i = 0
@@ -188,14 +218,38 @@ class OinkScript:
     # ------------------------------------------------------------------
     _BUILTINS = ("clear", "echo", "if", "include", "jump", "label", "log",
                  "next", "print", "shell", "variable",
-                 "input", "mr", "output", "set")
+                 "input", "mr", "output", "set", "resume")
 
     def _execute(self, command: str, args: List[str]):
         if command in self._BUILTINS:
+            # resume replay: builtins re-run so loop variables and
+            # control flow reproduce — EXCEPT `shell`, whose arbitrary
+            # filesystem side effects (mv/rm) already happened before
+            # the checkpoint and must not replay
+            if self._ft_skip > 0 and command == "shell":
+                return
             getattr(self, "cmd_" + command)(args)
             return
+        if self._ft_skip > 0:
+            # resume replay: the first _ft_skip command EXECUTIONS are
+            # already durable in the restore checkpoint — skip them,
+            # then load the checkpointed MRs.  ANY non-builtin word
+            # counts: a skipped registered command may be what names
+            # the MR a later prefix line dispatches on (`-o NULL x`
+            # then `x ...`), so `x` not being in obj.named yet is
+            # expected, not an unknown command
+            self._ft_skip -= 1
+            if self._ft_skip == 0:
+                self._ft_apply_restore()
+            return
+        # the pending begin lands BEFORE the first command starts: a
+        # crash mid-command-1 must still leave a resumable journal,
+        # while a builtins-only script (the `resume <dir>` one-liner)
+        # never writes one
+        self._ft_flush_begin()
         if command in COMMANDS:
             self._run_registered(command, args)
+            self._ft_cmd_done(command)
             return
         if command in self.obj.named:
             from ..obs import get_tracer
@@ -204,8 +258,42 @@ class OinkScript:
                                    args=" ".join(args)):
                 self.dispatch.run(command, args)
             self.deltatime = _time.perf_counter() - t0
+            self._ft_cmd_done(command)
             return
         raise MRError(f"Unknown command: {command}")
+
+    def _ft_flush_begin(self):
+        j = self._ft_journal
+        if j is not None and self._ft_pending_begin is not None:
+            lines, name = self._ft_pending_begin
+            self._ft_pending_begin = None
+            j.begin(lines, name)
+
+    def _ft_cmd_done(self, command: str):
+        """Journal one COMPLETED command (record follows the fact) and
+        auto-checkpoint every MRTPU_CKPT_EVERY commands."""
+        j = self._ft_journal
+        if j is not None:
+            self._ft_flush_begin()
+            j.cmd_done(command)
+            j.maybe_checkpoint(self.obj)
+
+    def _ft_apply_restore(self):
+        rec, self._ft_restore = self._ft_restore, None
+        if not rec:
+            return
+        ckpt, dir = rec
+        from ..ft.journal import restore_mrs
+        restore_mrs(self.obj, ckpt, dir)
+
+    def cmd_resume(self, args):
+        """resume <dir> — replay the op journal under <dir> from its
+        last durable checkpoint into THIS interpreter (ft/journal.py;
+        doc/reliability.md has the runbook)."""
+        if len(args) != 1:
+            raise MRError("Illegal resume command")
+        from ..ft.journal import resume_into
+        resume_into(self, args[0])
 
     def _run_registered(self, name: str, args: List[str]):
         """-i/-o switch split + params + run (input.cpp:429-468)."""
@@ -454,6 +542,9 @@ class OinkScript:
             key, val = args[i], args[i + 1]
             if key == "scratch":
                 self.obj.set_default("fpath", val)
+            elif key == "onfault":
+                # string-valued ft/ policy (fail|retry|skip)
+                self.obj.set_default("onfault", val)
             elif key == "prepend":
                 self._path_prepend = val
             elif key == "substitute":

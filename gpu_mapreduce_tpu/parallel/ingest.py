@@ -72,12 +72,19 @@ def balance_by_bytes(names: Sequence[str], P: int):
 
 
 def run_sinks(payloads, call: Callable, threaded: bool = True,
-              base: int = 0, pool=None):
+              base: int = 0, pool=None, onfault: str = "fail",
+              shard=None):
     """Run ``call(base+i, payload, sink)`` for every payload into
     private _TaskSink buffers; returns the sinks in task order.
     Threaded by default (the per-rank parallel read the reference gets
     from MPI); assembly order is by task index either way, so the
     result is deterministic regardless of scheduling.
+
+    Every task runs through the ft/ ingest policy (``ft.retry
+    .ingest_task``): fault points, bounded retries into a fresh private
+    buffer per attempt (a retried task can never duplicate pairs or
+    reorder — sinks are positional), OSError→MRError wrapping naming
+    file/shard/task, and quarantine-skip under ``onfault="skip"``.
 
     ``pool``: a shared ThreadPoolExecutor (``MapReduce._ingest_pool`` —
     one pool per MapReduce instead of a fresh executor per call); when
@@ -86,13 +93,15 @@ def run_sinks(payloads, call: Callable, threaded: bool = True,
     import contextlib
     from concurrent.futures import ThreadPoolExecutor
     from ..core.mapreduce import _TaskSink
+    from ..ft.retry import ingest_task
     from ..obs import get_tracer
     sinks = [_TaskSink() for _ in payloads]
     with get_tracer().span("ingest.read", cat="ingest",
                            ntasks=len(payloads), threaded=threaded):
         if not threaded or len(payloads) <= 1:
             for i, p in enumerate(payloads):
-                call(base + i, p, sinks[i])
+                ingest_task(call, base + i, p, sinks[i],
+                            onfault=onfault, shard=shard)
             return sinks
         # one submit/drain loop for both executors: a shared pool stays
         # open (nullcontext), a private one tears down here
@@ -103,7 +112,8 @@ def run_sinks(payloads, call: Callable, threaded: bool = True,
                                   len(payloads)))
             ctx = ThreadPoolExecutor(nworkers)
         with ctx as ex:
-            futs = [ex.submit(call, base + i, p, sinks[i])
+            futs = [ex.submit(ingest_task, call, base + i, p, sinks[i],
+                              onfault=onfault, shard=shard)
                     for i, p in enumerate(payloads)]
             for f in futs:
                 f.result()   # propagate callback exceptions
@@ -267,22 +277,48 @@ def build_sharded(frames: List[KVFrame], mesh):
                      key_decode=ktables, value_decode=vtables)
 
 
+
+def _balanced_shards(names: Sequence[str], P: int,
+                     onfault: str) -> List[List[str]]:
+    """balance_by_bytes under the ft/ discovery policy — ONE copy for
+    both mesh map paths: a file that vanished between findfiles and
+    the byte balance gets the SAME disposition a task-time failure
+    would (MRError naming it, or quarantine-drop + rebalance under
+    onfault="skip"), so which stage notices a bad input never decides
+    whether the run survives it."""
+    from ..ft.retry import quarantine_or_raise
+    names = list(names)
+    while True:
+        try:
+            return [files for _, files, _ in balance_by_bytes(names, P)]
+        except OSError as e:
+            bad = getattr(e, "filename", None)
+            if bad in names:
+                quarantine_or_raise(e, bad, onfault)
+                names.remove(bad)
+            else:
+                quarantine_or_raise(e, bad, "fail")
+
+
 def _shard_sink_stream(shards_payloads, call: Callable, threaded: bool,
-                       pool):
+                       pool, onfault: str = "fail"):
     """Generator of per-shard sink lists: ``run_sinks`` over each
     shard's payloads in turn, with GLOBAL task numbering (cumulative
     base).  This is the producer half the prefetch pipeline runs in its
     background thread — read + tokenize shard N+1 while the consumer
-    assembles/interns shard N's frame."""
+    assembles/interns shard N's frame.  A retry inside ``run_sinks``
+    happens WITHIN a task slot, so the producer can never reorder
+    frames (the chaos golden contract)."""
     itask = 0
-    for payloads in shards_payloads:
+    for sidx, payloads in enumerate(shards_payloads):
         sinks = run_sinks(payloads, call, threaded=threaded, base=itask,
-                          pool=pool)
+                          pool=pool, onfault=onfault, shard=sidx)
         itask += len(payloads)
         yield sinks
 
 
-def _pooled_file_sink_stream(shards, call: Callable, pool):
+def _pooled_file_sink_stream(shards, call: Callable, pool,
+                             onfault: str = "fail"):
     """mapstyle-2 map_files producer: EVERY file's task submits to the
     shared pool up front (the full cross-file parallelism the pre-exec
     single run_sinks had — a P-shard mesh with ~1 file per shard must
@@ -290,12 +326,15 @@ def _pooled_file_sink_stream(shards, call: Callable, pool):
     order as their futures complete, so the consumer assembles shard N
     while shards > N are still reading."""
     from ..core.mapreduce import _TaskSink
+    from ..ft.retry import ingest_task
     from ..obs import get_tracer
     names = [f for files in shards for f in files]
+    shard_of = [s for s, files in enumerate(shards) for _ in files]
     sinks = [_TaskSink() for _ in names]
     with get_tracer().span("ingest.read", cat="ingest",
                            ntasks=len(names), threaded=True):
-        futs = [pool.submit(call, i, name, sinks[i])
+        futs = [pool.submit(ingest_task, call, i, name, sinks[i],
+                            onfault=onfault, shard=shard_of[i])
                 for i, name in enumerate(names)]
         i = 0
         for files in shards:
@@ -318,7 +357,8 @@ def mesh_map_files(mr, kv, names: Sequence[str], call: Callable) -> dict:
     from ..exec import prefetch_iter
     from .mesh import mesh_axis_size
     P = mesh_axis_size(mr.backend.mesh)
-    shards = [files for _, files, _ in balance_by_bytes(names, P)]
+    onfault = mr.settings.onfault
+    shards = _balanced_shards(names, P, onfault)
     stats = {"mode": "mesh", "shards": P,
              "files_per_shard": [len(s) for s in shards]}
     threaded = mr.settings.mapstyle == 2
@@ -326,9 +366,11 @@ def mesh_map_files(mr, kv, names: Sequence[str], call: Callable) -> dict:
         # all files in flight on the shared pool at once (cross-file
         # parallelism), groups stream out in shard order
         stream = _pooled_file_sink_stream(shards, call,
-                                          mr._ingest_pool())
+                                          mr._ingest_pool(),
+                                          onfault=onfault)
     else:
-        stream = _shard_sink_stream(shards, call, False, None)
+        stream = _shard_sink_stream(shards, call, False, None,
+                                    onfault=onfault)
     frames: List[KVFrame] = []
     done_sinks: List[list] = []   # per-shard sinks kept for fallback
     failed = None
@@ -379,10 +421,12 @@ def mesh_map_chunks(mr, kv, names: Sequence[str], per_file: int, sep: bytes,
     lazy-window property, kept; the exec/ prefetch pipeline holds at
     most MRTPU_PREFETCH extra shards' tokenized sinks)."""
     from ..exec import prefetch_iter
+    from ..ft.retry import ingest_read
     from ..utils.io import file_chunks
     from .mesh import mesh_axis_size
     P = mesh_axis_size(mr.backend.mesh)
-    shards = [files for _, files, _ in balance_by_bytes(names, P)]
+    onfault = mr.settings.onfault
+    shards = _balanced_shards(names, P, onfault)
     stats = {"mode": "mesh", "shards": P,
              "files_per_shard": [len(s) for s in shards],
              "chunks_per_shard": []}
@@ -393,10 +437,18 @@ def mesh_map_chunks(mr, kv, names: Sequence[str], per_file: int, sep: bytes,
     def shard_payloads():
         # producer side: the raw chunk bytes of one shard materialize,
         # tokenize through the callbacks, and release before the next
-        # shard reads (run_sinks happens in _shard_sink_stream)
-        for chunk_files in shards:
-            payloads = [c for fname in chunk_files
-                        for c in file_chunks(fname, per_file, sep, delta)]
+        # shard reads (run_sinks happens in _shard_sink_stream).  Each
+        # file reads under the ft/ ingest.read policy: retry budget,
+        # MRError naming the file, quarantine-skip under onfault=skip
+        for sidx, chunk_files in enumerate(shards):
+            payloads = []
+            for fname in chunk_files:
+                chunks = ingest_read(
+                    lambda f=fname: list(file_chunks(f, per_file, sep,
+                                                     delta)),
+                    file=fname, onfault=onfault, shard=sidx)
+                if chunks is not None:
+                    payloads.extend(chunks)
             stats["chunks_per_shard"].append(len(payloads))
             counts["ntasks"] += len(payloads)
             yield payloads
@@ -405,7 +457,8 @@ def mesh_map_chunks(mr, kv, names: Sequence[str], per_file: int, sep: bytes,
     done_sinks: List[list] = []   # per-shard sinks kept for fallback
     failed = None
     for sinks in prefetch_iter(
-            _shard_sink_stream(shard_payloads(), call, threaded, pool),
+            _shard_sink_stream(shard_payloads(), call, threaded, pool,
+                               onfault=onfault),
             path="ingest.chunks"):
         if failed is not None:
             for s in sinks:

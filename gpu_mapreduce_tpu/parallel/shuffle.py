@@ -468,15 +468,38 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
 
     Emits a ``shuffle.exchange`` child span (obs/) under the calling MR
     op carrying the flow-control telemetry (bucket/rounds/caps, useful
-    vs padding bytes, whether the speculative caps held)."""
+    vs padding bytes, whether the speculative caps held).
+
+    Runs under the ft/ ``shuffle.exchange`` retry policy: a transient
+    failure retries the WHOLE two-phase exchange — but only while the
+    input buffers still exist (a failure after the donated phase-1
+    dispatch consumed them is vetoed as non-retryable and propagates to
+    ``free_if_donated`` as before).  The injection fault point sits
+    before any dispatch, so injected faults are always retry-safe."""
+    from ..ft.inject import fault_point
+    from ..ft.retry import retry_call
     from ..obs import NULL_SPAN, get_tracer
-    tr = get_tracer()
-    if not tr.enabled:
-        return _exchange_impl(skv, dest, transport, counters, NULL_SPAN)
-    with tr.span("shuffle.exchange", cat="shuffle",
-                 nprocs=mesh_axis_size(skv.mesh),
-                 transport=transport) as sp:
-        return _exchange_impl(skv, dest, transport, counters, sp)
+
+    def _once():
+        fault_point("shuffle.exchange")
+        tr = get_tracer()
+        if not tr.enabled:
+            return _exchange_impl(skv, dest, transport, counters,
+                                  NULL_SPAN)
+        with tr.span("shuffle.exchange", cat="shuffle",
+                     nprocs=mesh_axis_size(skv.mesh),
+                     transport=transport) as sp:
+            return _exchange_impl(skv, dest, transport, counters, sp)
+
+    def _retryable(e):
+        try:
+            return not skv.key.is_deleted()
+        except Exception:
+            return False
+
+    return retry_call("shuffle.exchange", _once,
+                      detail=f"P={mesh_axis_size(skv.mesh)}",
+                      retryable=_retryable)
 
 
 def _exchange_impl(skv: ShardedKV, dest, transport: int,

@@ -1,13 +1,13 @@
 #!/usr/bin/env bash
 # CI gate: the ROADMAP tier-1 suite plus fast subsets (fused-plan
 # equivalence, metrics/flight-recorder, exec overlap/donation golden
-# equivalence) so a regression there fails loudly even when only the
-# quick gate runs, and an ADVISORY bench regression check
-# (scripts/bench_compare.py) that prints its verdict table into the CI
-# log but never fails the build.
+# equivalence, ft chaos-golden/resume) so a regression there fails
+# loudly even when only the quick gate runs, and an ADVISORY bench
+# regression check (scripts/bench_compare.py) that prints its verdict
+# table into the CI log but never fails the build.
 #
-#   scripts/ci.sh          # tier-1 + plan/metrics/exec subsets + advisory
-#   scripts/ci.sh quick    # plan + metrics + exec subsets only (~1 min)
+#   scripts/ci.sh          # tier-1 + plan/metrics/exec/ft subsets + advisory
+#   scripts/ci.sh quick    # plan + metrics + exec + ft subsets only (~1 min)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -29,6 +29,12 @@ run_exec_subset() {
       -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_ft_subset() {
+  echo "== ft chaos-golden / retry / resume subset (fast) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_ft.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 bench_compare_advisory() {
   # advisory only: the verdict table lands in the CI log; a regression
   # (or a compare bug) must not fail the build — bench.py --gate is the
@@ -41,6 +47,7 @@ if [ "${1:-}" = "quick" ]; then
   run_plan_subset
   run_metrics_subset
   run_exec_subset
+  run_ft_subset
   bench_compare_advisory
   exit 0
 fi
@@ -58,4 +65,5 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
 run_plan_subset
 run_metrics_subset
 run_exec_subset
+run_ft_subset
 bench_compare_advisory

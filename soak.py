@@ -13,11 +13,16 @@ framework surface:
   the BASELINE.json north-star metric (the reference's pagerank is a
   stub, oink/pagerank.cpp:53-55, so this races no reference number)
 
-Usage:  python soak.py [--metrics-every N]
+Usage:  python soak.py [--metrics-every N] [--chaos SEED]
         (scale from SOAK_SCALE, default 18; N also via
         SOAK_METRICS_EVERY — print a live metrics snapshot line after
         every N workloads and write a final full-registry snapshot to
-        SOAK_METRICS_OUT, default soak_metrics.json, next to the log)
+        SOAK_METRICS_OUT, default soak_metrics.json, next to the log.
+        --chaos SEED adds a chaos workload: the standard wordfreq +
+        external-sort pipelines re-run under a small seeded fault
+        schedule at every registered ft/ site with retries armed,
+        asserting output equality with the fault-free run and
+        publishing the retry/fault counters — doc/reliability.md)
 Writes: BASELINE.json published.{rmat_edges_per_sec, degree_edges_per_sec,
         cc_find_edges_per_sec_per_iter, pagerank_edges_per_sec_per_iter}
 """
@@ -99,6 +104,15 @@ def main():
         except ValueError as e:
             print(f"--metrics-every ignored: {e!r}", file=sys.stderr)
             metrics_every = 0
+    chaos_seed = env_knob("SOAK_CHAOS", int, None)
+    if "--chaos" in sys.argv:
+        i = sys.argv.index("--chaos")
+        try:
+            chaos_seed = int(sys.argv[i + 1]) \
+                if i + 1 < len(sys.argv) else 0
+        except ValueError as e:
+            print(f"--chaos ignored: {e!r}", file=sys.stderr)
+            chaos_seed = None
 
     backend = jax.default_backend()
     published = {}
@@ -376,12 +390,109 @@ def main():
               f"{len(e2) / per_iter:,.0f} edges/s/iter "
               f"(sum={float(np.asarray(ranks).sum()):.4f})")
 
+    def do_chaos():
+        # chaos round (ft/): the standard wordfreq + external-sort
+        # shapes re-run under a seeded fault schedule hitting EVERY
+        # registered site, with retry budgets armed; the run only
+        # publishes if the faulted output equals the fault-free run —
+        # the soak-scale version of tests/test_ft.py's chaos goldens
+        import collections
+        import tempfile
+        from gpu_mapreduce_tpu import ft
+        from gpu_mapreduce_tpu.ops.reduces import count as count_kernel
+        from gpu_mapreduce_tpu.utils.io import read_words
+
+        def wordfreq_pairs(files, ckpt):
+            mr = MapReduce(mesh)
+
+            def fileread(itask, fname, kv, ptr):
+                with open(fname, "rb") as f:
+                    ws = read_words(f.read())
+                kv.add_batch(ws, np.ones(len(ws), np.int64))
+
+            mr.map_files(files, fileread)
+            mr.collate()
+            mr.reduce(count_kernel, batch=True)
+            mr.save(ckpt)
+            return sorted((bytes(k), int(v)) for fr in mr.kv.frames()
+                          for k, v in fr.pairs())
+
+        def extsort_rows(tag, fpath):
+            rng4 = np.random.default_rng(23)
+            # at least 2 MB of 16 B rows: the 1 MB page budget must
+            # actually spill, or the spill.* sites never probe
+            rows = max(1 << 17, min(nedges, 1 << 18))
+            keys = rng4.integers(0, 1 << 40, rows).astype(np.uint64)
+            mre = MapReduce(outofcore=1, memsize=1, maxpage=1,
+                            fpath=fpath)
+            step = max(1, rows // 5)
+            mre.map(1, lambda i, kv, p: [
+                kv.add_batch(keys[s:s + step], keys[s:s + step])
+                for s in range(0, rows, step)])
+            mre.sort_keys(1)
+            return [int(k) for fr in mre.kv.frames()
+                    for k, _ in fr.pairs()]
+
+        with tempfile.TemporaryDirectory() as tmp:
+            rng3 = np.random.default_rng(chaos_seed)
+            vocab = np.array([b"w%04d" % i for i in range(512)], object)
+            files = []
+            for i in range(6):
+                ws = vocab[rng3.integers(0, len(vocab), 4096)]
+                p = os.path.join(tmp, f"chaos-{i}.txt")
+                with open(p, "wb") as f:
+                    f.write(b" ".join(ws.tolist()))
+                files.append(p)
+            clean_wf = wordfreq_pairs(files, os.path.join(tmp, "ck0"))
+            clean_es = extsort_rows("clean", os.path.join(tmp, "sp0"))
+            ft.reset()
+            # rate × probe counts ⇒ a handful of faults per site;
+            # max_faults=3 bounds the worst case well under the budget
+            # (ingest.read + ingest.tokenize share a task's budget)
+            for site in ft.SITES:
+                ft.schedule(site=site, rate=0.2, seed=chaos_seed,
+                            max_faults=3)
+                ft.set_budget(site, 8)
+            try:
+                chaos_wf = wordfreq_pairs(files, os.path.join(tmp,
+                                                              "ck1"))
+                chaos_es = extsort_rows("chaos", os.path.join(tmp,
+                                                              "sp1"))
+                assert chaos_wf == clean_wf, "chaos wordfreq diverged"
+                assert chaos_es == clean_es, "chaos extsort diverged"
+                faults = ft.fault_counts()
+                retries = ft.retries_snapshot()
+                # a chaos round that injected NOTHING proved nothing —
+                # a schedule regression must read as a failed workload,
+                # never as a green chaos_ok over two fault-free runs
+                assert sum(faults.values()) >= 1, \
+                    "chaos schedule injected no faults"
+                published["chaos_ok"] = 1
+                published["chaos_faults_injected"] = int(
+                    sum(faults.values()))
+                published["chaos_retries_total"] = int(sum(
+                    n for (s, o), n in retries.items()
+                    if o == "retry"))
+                published["chaos_recovered_total"] = int(sum(
+                    n for (s, o), n in retries.items()
+                    if o == "recovered"))
+                per_site = collections.Counter(faults)
+                print(f"chaos seed={chaos_seed}: outputs identical; "
+                      f"{sum(faults.values())} faults injected "
+                      f"({dict(per_site)}), "
+                      f"{published['chaos_retries_total']} retries, "
+                      f"{published['chaos_recovered_total']} recovered")
+            finally:
+                ft.reset()
+
     workloads = [("degree", do_degree), ("cc_find", do_cc),
                  ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),
                  ("external", do_external),
                  ("ingest", do_ingest_overlap),
                  ("pagerank", do_pagerank),
                  ("pagerank_northstar", do_pagerank_northstar)]
+    if chaos_seed is not None:
+        workloads.append(("chaos", do_chaos))
     for i, (name, fn) in enumerate(workloads, 1):
         guard(name, fn)
         if metrics_every and i % metrics_every == 0:

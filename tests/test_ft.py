@@ -1,0 +1,922 @@
+"""Fault-tolerance subsystem (ft/): deterministic injection, retry/
+backoff policy, quarantine-skip, MRError wrapping of raw input-file
+OSErrors, and journaled kill-and-resume.
+
+The chaos golden contract mirrors exec/: any seeded fault schedule the
+retry budget absorbs must leave output BYTE-IDENTICAL to the fault-free
+run — on wordfreq (host and mesh), an invertedindex-shaped postings
+pipeline, the external sort's spill sites, and checkpoint.save."""
+
+import collections
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu import ft
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.core.runtime import MRError
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+from gpu_mapreduce_tpu.utils.io import read_words
+import gpu_mapreduce_tpu.ft.retry as ftr
+
+
+@pytest.fixture(autouse=True)
+def ft_state(monkeypatch):
+    """Reset injection schedules, budgets, counters and journals around
+    every test, and record backoff sleeps instead of sleeping."""
+    slept = []
+    monkeypatch.setattr(ftr, "_sleep", slept.append)
+    ft.reset()
+    yield slept
+    ft.reset()
+
+
+@pytest.fixture
+def word_corpus(tmp_path):
+    import random
+    r = random.Random(41)
+    vocab = [f"word{i:03d}".encode() for i in range(120)]
+    files, oracle = [], collections.Counter()
+    for i in range(6):
+        ws = r.choices(vocab, k=300 + 40 * i)
+        oracle.update(ws)
+        p = tmp_path / f"c{i}.txt"
+        p.write_bytes(b" ".join(ws))
+        files.append(str(p))
+    return files, oracle
+
+
+# ---------------------------------------------------------------------------
+# injection mechanics
+# ---------------------------------------------------------------------------
+
+def test_parse_faults_env_format():
+    specs = ft.parse_faults(
+        "seed=7;site=ingest.read;rate=0.05;kind=oserror"
+        "|site=spill.read,spill.write;rate=1.0;n=2;after=3")
+    assert len(specs) == 3
+    assert specs[0].site == "ingest.read" and specs[0].seed == 7
+    assert specs[0].rate == 0.05 and specs[0].kind == "oserror"
+    assert {s.site for s in specs[1:]} == {"spill.read", "spill.write"}
+    assert specs[1].max_faults == 2 and specs[1].after == 3
+    with pytest.raises(ValueError):
+        ft.parse_faults("site=nonexistent.site")
+    with pytest.raises(ValueError):
+        ft.parse_faults("kind=meteor")
+    with pytest.raises(ValueError):
+        ft.parse_faults("bogus")
+
+
+def test_fault_point_deterministic_and_counted():
+    """Same seed → the same probes fault, independent of wall time."""
+    def verdicts():
+        ft.reset()
+        ft.schedule(site="spill.read", rate=0.3, seed=99)
+        out = []
+        for _ in range(40):
+            try:
+                ft.fault_point("spill.read")
+                out.append(False)
+            except OSError:
+                out.append(True)
+        return out
+
+    a, b = verdicts(), verdicts()
+    assert a == b
+    assert any(a) and not all(a)
+    assert ft.fault_counts()["spill.read"] == sum(b)
+
+
+def test_disarmed_is_noop():
+    for site in ft.SITES:
+        ft.fault_point(site)          # never raises
+    assert ft.fault_counts() == {}
+    assert ft.retries_snapshot() == {}
+
+
+def test_injected_exception_kinds():
+    from gpu_mapreduce_tpu.ft.inject import (InjectedFatal,
+                                             InjectedOSError,
+                                             InjectedTimeout)
+    for kind, cls in (("oserror", InjectedOSError),
+                      ("timeout", InjectedTimeout),
+                      ("fatal", InjectedFatal)):
+        ft.reset()
+        ft.schedule(site="spill.write", rate=1.0, kind=kind)
+        with pytest.raises(cls) as ei:
+            ft.fault_point("spill.write")
+        assert ei.value.ft_site == "spill.write"
+    assert ft.classify("spill.write", InjectedOSError()) == "transient"
+    assert ft.classify("spill.write", InjectedFatal()) == "fatal"
+
+
+def test_env_arming_via_mapreduce_constructor(monkeypatch):
+    monkeypatch.setenv("MRTPU_FAULTS",
+                       "seed=3;site=spill.read;rate=1.0;n=1")
+    monkeypatch.setenv("MRTPU_RETRY", "spill.read=4")
+    MapReduce()            # construction applies the env knobs
+    assert ft.budget("spill.read") == 4
+    with pytest.raises(OSError):
+        ft.fault_point("spill.read")
+    ft.fault_point("spill.read")      # n=1: second probe passes
+    monkeypatch.setenv("MRTPU_FAULTS", "")
+    monkeypatch.setenv("MRTPU_RETRY", "")
+    MapReduce()            # change applies again
+    assert ft.budget("spill.read") == 0
+
+
+def test_malformed_env_warns_and_disarms(monkeypatch, capsys):
+    monkeypatch.setenv("MRTPU_FAULTS", "site=nope.nope")
+    monkeypatch.setenv("MRTPU_RETRY", "spill.read=lots")
+    MapReduce()
+    err = capsys.readouterr().err
+    assert "MRTPU_FAULTS ignored" in err
+    assert "MRTPU_RETRY ignored" in err
+    ft.fault_point("spill.read")      # disarmed, not crashed
+
+
+# ---------------------------------------------------------------------------
+# retry engine
+# ---------------------------------------------------------------------------
+
+def test_retry_recovers_and_counts(ft_state):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    ft.set_budget("spill.read", 5)
+    assert ft.retry_call("spill.read", flaky) == "ok"
+    snap = ft.retries_snapshot()
+    assert snap[("spill.read", "retry")] == 2
+    assert snap[("spill.read", "recovered")] == 1
+    assert len(ft_state) == 2          # one backoff sleep per retry
+
+
+def test_retry_exhausted_raises_mrerror_naming_site():
+    ft.set_budget("spill.write", 2)
+
+    def always():
+        raise OSError("disk flaking")
+
+    with pytest.raises(MRError) as ei:
+        ft.retry_call("spill.write", always, detail="/spool/run7")
+    msg = str(ei.value)
+    assert "spill.write" in msg and "3 attempts" in msg
+    assert "/spool/run7" in msg and "disk flaking" in msg
+    assert isinstance(ei.value.__cause__, OSError)
+    assert ft.retries_snapshot()[("spill.write", "exhausted")] == 1
+
+
+def test_fatal_errors_never_retry(ft_state):
+    ft.set_budget("ingest.read", 5)
+
+    def poison():
+        raise ValueError("bad data, retry cannot help")
+
+    with pytest.raises(ValueError):
+        ft.retry_call("ingest.read", poison)
+    assert ft_state == []              # no backoff sleeps happened
+    assert ft.retries_snapshot()[("ingest.read", "fatal")] == 1
+    # a deterministically-missing file is fatal too
+    assert ft.classify("ingest.read", FileNotFoundError()) == "fatal"
+
+
+def test_backoff_is_exponential_capped_and_jittered(ft_state, monkeypatch):
+    monkeypatch.setenv("MRTPU_RETRY_BACKOFF", "0.1")
+    monkeypatch.setenv("MRTPU_RETRY_BACKOFF_MAX", "0.5")
+    ft.set_budget("spill.read", 6)
+
+    def always():
+        raise OSError("x")
+
+    with pytest.raises(MRError):
+        ft.retry_call("spill.read", always)
+    delays = list(ft_state)
+    assert len(delays) == 6
+    # jitter scales [0.5, 1.0) of base*2^k, capped at 0.5
+    for k, d in enumerate(delays):
+        nominal = min(0.5, 0.1 * 2 ** k)
+        assert 0.5 * nominal <= d < nominal
+    assert delays[2] > delays[0]       # growth is visible through jitter
+    assert max(delays) < 0.5           # the cap held
+
+
+def test_budget_zero_is_passthrough():
+    """Disarmed sites add no wrapper frames and no error rewriting."""
+    with pytest.raises(OSError) as ei:
+        ft.retry_call("spill.read", lambda: (_ for _ in ()).throw(
+            OSError("raw")))
+    assert type(ei.value) is OSError   # not MRError-wrapped
+    assert ft.retries_snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# chaos goldens: faulted-with-retry output == fault-free output
+# ---------------------------------------------------------------------------
+
+def _arm_all_sites(budget=3, max_faults=1):
+    for site in ft.SITES:
+        ft.schedule(site=site, rate=1.0, seed=11, max_faults=max_faults)
+        ft.set_budget(site, budget)
+
+
+def _wordfreq_pairs(files, comm, ckpt_dir):
+    """Mesh/host wordfreq through the raw op algebra + a checkpoint
+    round-trip, so ingest.*, shuffle.exchange AND checkpoint.save all
+    probe; returns (sorted pairs, reloaded pairs)."""
+    from gpu_mapreduce_tpu.ops.reduces import count
+    mr = MapReduce(comm)
+
+    def fileread(itask, fname, kv, ptr):
+        with open(fname, "rb") as f:
+            ws = read_words(f.read())
+        kv.add_batch(ws, np.ones(len(ws), np.int64))
+
+    mr.map_files(list(files), fileread)
+    mr.collate()
+    mr.reduce(count, batch=True)
+    pairs = sorted((bytes(k), int(v)) for fr in mr.kv.frames()
+                   for k, v in fr.pairs())
+    mr.save(ckpt_dir)
+    mr2 = MapReduce(comm)
+    mr2.load(ckpt_dir)
+    pairs2 = sorted((bytes(k), int(v)) for fr in mr2.kv.frames()
+                    for k, v in fr.pairs())
+    return pairs, pairs2
+
+
+def test_chaos_golden_mesh_wordfreq_all_sites(word_corpus, tmp_path):
+    """THE acceptance golden: a seeded schedule injecting ≥1 fault at
+    every reachable registered site leaves mesh wordfreq output (and
+    its checkpoint round-trip) byte-identical to the fault-free run,
+    with retries visible in mr.stats()["ft"] and, when armed, in
+    mrtpu_retries_total."""
+    from gpu_mapreduce_tpu.obs import get_tracer, metrics as obs_metrics
+    files, oracle = word_corpus
+    clean, clean2 = _wordfreq_pairs(files, make_mesh(4),
+                                    str(tmp_path / "ck.clean"))
+    assert collections.Counter(dict(clean)) == oracle
+    assert clean == clean2
+
+    obs_metrics.reset()
+    get_tracer().reset()
+    try:
+        obs_metrics.enable_metrics(flight=False)
+        _arm_all_sites(budget=3, max_faults=1)
+        chaos, chaos2 = _wordfreq_pairs(files, make_mesh(4),
+                                        str(tmp_path / "ck.chaos"))
+        assert chaos == clean            # byte-identical under faults
+        assert chaos2 == clean
+        faults = ft.fault_counts()
+        for site in ("ingest.read", "ingest.tokenize",
+                     "shuffle.exchange", "checkpoint.save"):
+            assert faults.get(site, 0) >= 1, (site, faults)
+        st = MapReduce(make_mesh(4)).stats()["ft"]
+        assert st["faults_injected"] == faults
+        assert st["retries"]["shuffle.exchange"]["recovered"] >= 1
+        # the registry counted the same retries (collector pull)
+        snap = obs_metrics.snapshot()
+        got = {(s["labels"]["site"], s["labels"]["outcome"])
+               for s in snap["mrtpu_retries_total"]["samples"]}
+        assert ("shuffle.exchange", "recovered") in got
+        assert {s["labels"]["site"]
+                for s in snap["mrtpu_faults_injected_total"]["samples"]
+                } >= {"ingest.read", "shuffle.exchange"}
+    finally:
+        obs_metrics.reset()
+        get_tracer().reset()
+
+
+def test_chaos_golden_serial_wordfreq(word_corpus, tmp_path):
+    # budget must cover the COMBINED per-task faults of ingest.read and
+    # ingest.tokenize (both probe inside the same retried task slot)
+    files, oracle = word_corpus
+    clean, _ = _wordfreq_pairs(files, None, str(tmp_path / "s.clean"))
+    _arm_all_sites(budget=5, max_faults=2)
+    chaos, chaos2 = _wordfreq_pairs(files, None,
+                                    str(tmp_path / "s.chaos"))
+    assert chaos == clean == chaos2
+    assert ft.fault_counts().get("ingest.read", 0) >= 1
+
+
+def test_chaos_golden_invertedindex_postings(word_corpus):
+    """Composed invertedindex shape: (word, doc) postings counts over a
+    mesh, byte-identical under injection at the ingest+shuffle sites."""
+    files, _ = word_corpus
+
+    def postings(comm):
+        mr = MapReduce(comm)
+
+        def emit(itask, fname, kv, ptr):
+            with open(fname, "rb") as f:
+                ws = list(dict.fromkeys(read_words(f.read())))
+            kv.add_batch(ws, np.full(len(ws), itask, np.int64))
+
+        mr.map_files(list(files), emit)
+        mr.collate()
+
+        def fold(key, vals, kv, ptr):
+            kv.add(key, len(vals))
+
+        mr.reduce(fold)
+        return sorted((bytes(k), int(v)) for fr in mr.kv.frames()
+                      for k, v in fr.pairs())
+
+    clean = postings(make_mesh(4))
+    _arm_all_sites(budget=3, max_faults=1)
+    assert postings(make_mesh(4)) == clean
+    assert ft.fault_counts().get("shuffle.exchange", 0) >= 1
+
+
+N_SPILL_ROWS = 3 * (1 << 20) // 16      # ~3 pages of 16 B rows, memsize=1
+
+
+def test_chaos_golden_external_sort_spill_sites(tmp_path, rng):
+    """spill.write + spill.read fault under retry: the external sort's
+    run files are immutable/atomic, so retried writes and block reads
+    reproduce the identical sorted stream."""
+    def sort_rows(tag, rng_):
+        mr = MapReduce(outofcore=1, memsize=1, maxpage=1,
+                       fpath=str(tmp_path / tag))
+        keys = rng_.integers(0, 1 << 40, N_SPILL_ROWS).astype(np.uint64)
+        vals = np.arange(len(keys), dtype=np.uint64)
+        step = len(keys) // 5
+        mr.map(1, lambda i, kv, p: [kv.add_batch(keys[s:s + step],
+                                                 vals[s:s + step])
+                                    for s in range(0, len(keys), step)])
+        mr.sort_keys(1)
+        return [(int(k), int(v)) for fr in mr.kv.frames()
+                for k, v in fr.pairs()]
+
+    clean = sort_rows("clean", rng)
+    for site in ("spill.write", "spill.read"):
+        ft.schedule(site=site, rate=1.0, seed=5, max_faults=2)
+        ft.set_budget(site, 3)
+    chaos = sort_rows("chaos", np.random.default_rng(12345))
+    assert chaos == clean
+    faults = ft.fault_counts()
+    assert faults["spill.write"] >= 1 and faults["spill.read"] >= 1
+    snap = ft.retries_snapshot()
+    assert snap[("spill.write", "recovered")] >= 1
+    assert snap[("spill.read", "recovered")] >= 1
+
+
+def test_chaos_exhausted_budget_fails_with_mrerror(word_corpus):
+    """More faults than budget: the run dies with the ft MRError (the
+    flight-recorder trigger), not a raw injected exception."""
+    files, _ = word_corpus
+    ft.schedule(site="ingest.read", rate=1.0, seed=2, max_faults=10)
+    ft.set_budget("ingest.read", 1)
+    mr = MapReduce(make_mesh(4))
+    with pytest.raises(MRError, match="ingest.read retry budget "
+                                      "exhausted"):
+        mr.map_files(list(files), lambda i, f, kv, p: kv.add(b"x", 1))
+
+
+# ---------------------------------------------------------------------------
+# satellite: raw OSError from a map input wraps as MRError (file/task)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("comm_factory", [lambda: None,
+                                          lambda: make_mesh(4)],
+                         ids=["serial", "mesh"])
+def test_unreadable_input_wraps_mrerror(word_corpus, comm_factory):
+    files, _ = word_corpus
+    bad = files[2]
+
+    def fileread(itask, fname, kv, ptr):
+        if fname == bad:
+            raise OSError(5, "Input/output error", fname)
+        with open(fname, "rb") as f:
+            ws = read_words(f.read())
+        kv.add_batch(ws, np.ones(len(ws), np.int64))
+
+    mr = MapReduce(comm_factory())
+    with pytest.raises(MRError) as ei:
+        mr.map_files(list(files), fileread)
+    msg = str(ei.value)
+    assert bad in msg and "task" in msg
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_vanished_file_on_mesh_chunk_path_wraps_mrerror(word_corpus,
+                                                        monkeypatch):
+    """A file that disappears between findfiles and the byte balance
+    must surface as MRError naming the file, not a raw getsize
+    OSError — on the mesh chunk path."""
+    files, _ = word_corpus
+    import gpu_mapreduce_tpu.parallel.ingest as ing
+    real = os.path.getsize
+
+    def flaky_getsize(p):
+        if p == files[1]:
+            raise OSError(2, "No such file or directory", p)
+        return real(p)
+
+    monkeypatch.setattr(ing.os.path, "getsize", flaky_getsize)
+    mr = MapReduce(make_mesh(4))
+    with pytest.raises(MRError, match="unreadable"):
+        mr.map_file_str(16, list(files), 0, 0, b" ", 32,
+                        lambda i, c, kv, p: kv.add(b"x", 1))
+
+
+# ---------------------------------------------------------------------------
+# onfault policy: skip-with-quarantine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("comm_factory", [lambda: None,
+                                          lambda: make_mesh(4)],
+                         ids=["serial", "mesh"])
+def test_quarantine_skip_accounting(word_corpus, comm_factory):
+    """onfault=skip: a poisoned input quarantines (with a record naming
+    site/task/file) and the run completes on the remaining inputs."""
+    files, oracle = word_corpus
+    poisoned = files[3]
+    with open(poisoned, "rb") as f:
+        poisoned_words = collections.Counter(read_words(f.read()))
+
+    def fileread(itask, fname, kv, ptr):
+        if fname == poisoned:
+            raise ValueError("corrupt encoding")
+        with open(fname, "rb") as f:
+            ws = read_words(f.read())
+        kv.add_batch(ws, np.ones(len(ws), np.int64))
+
+    mr = MapReduce(comm_factory(), onfault="skip")
+    n = mr.map_files(list(files), fileread)
+    want = oracle - poisoned_words
+    assert n == sum(want.values())
+    got = collections.Counter()
+    for fr in mr.kv.frames():
+        for k, v in fr.pairs():
+            got[bytes(k)] += 1
+    assert got == want
+    q = ft.quarantine_snapshot()
+    assert q["count"] == 1
+    rec = q["records"][0]
+    assert rec["file"] == poisoned and "ValueError" in rec["error"]
+    assert mr.stats()["ft"]["quarantined"]["count"] == 1
+
+
+def test_onfault_retry_default_budget_then_skip_vs_fail():
+    """onfault=retry grants a default ingest budget even with
+    MRTPU_RETRY unset; onfault validation rejects unknown values."""
+    ft.schedule(site="ingest.read", rate=1.0, seed=1, max_faults=2)
+    mr = MapReduce(onfault="retry")
+    n = mr.map(3, lambda i, kv, p: kv.add(i, i))
+    assert n == 3                      # two faults absorbed by retries
+    assert ft.retries_snapshot()[("ingest.read", "recovered")] >= 1
+    with pytest.raises(MRError, match="onfault"):
+        MapReduce(onfault="explode")
+
+
+def test_quarantine_after_exhausted_retries(word_corpus):
+    """onfault=skip composes with a budget: the input retries first,
+    quarantines only when the budget is spent."""
+    files, oracle = word_corpus
+    ft.schedule(site="ingest.tokenize", rate=1.0, seed=4)
+    ft.set_budget("ingest.tokenize", 1)
+    mr = MapReduce(onfault="skip")
+
+    def fileread(itask, fname, kv, ptr):
+        with open(fname, "rb") as f:
+            ws = read_words(f.read())
+        kv.add_batch(ws, np.ones(len(ws), np.int64))
+
+    n = mr.map_files(list(files), fileread)
+    # every task's two attempts both faulted → everything quarantined
+    assert n == 0
+    q = ft.quarantine_snapshot()
+    assert q["count"] == len(files)
+    assert q["by_site"] == {"ingest.tokenize": len(files)}
+    assert ft.retries_snapshot()[("ingest.tokenize", "retry")] == \
+        len(files)
+
+
+def test_injected_fatal_kills_through_onfault_skip(word_corpus):
+    """The kill switch must kill: onfault=skip quarantines per-input
+    failures, never the InjectedFatal the resume runbook relies on."""
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    files, _ = word_corpus
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, max_faults=1)
+    mr = MapReduce(onfault="skip")
+    with pytest.raises(InjectedFatal):
+        mr.map_files(list(files), lambda i, f, kv, p: kv.add(b"x", 1))
+    assert ft.quarantine_snapshot()["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# journal + kill-and-resume
+# ---------------------------------------------------------------------------
+
+def test_failed_optional_checkpoint_never_kills_the_run(tmp_path,
+                                                        monkeypatch):
+    """A transient OSError during an auto-checkpoint (no retry budget
+    armed) skips the round and retries at the next trigger — the
+    journaled run it protects keeps going."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft import journal as ftj
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "jk")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    calls = {"n": 0}
+    import gpu_mapreduce_tpu.core.checkpoint as ckpt
+    real = ckpt.save
+
+    def flaky_save(mr, path):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(28, "No space left on device")
+        return real(mr, path)
+
+    monkeypatch.setattr(ckpt, "save", flaky_save)
+    o1, o2 = str(tmp_path / "f1"), str(tmp_path / "f2")
+    OinkScript(screen=False).run_string(_script(d1, d2, o1, o2))
+    assert os.path.exists(o1) and os.path.exists(o2)
+    kinds = [r["kind"] for r in ft.read_journal(jdir)]
+    # round 1 failed (no record, partial dir dropped); round 2 landed
+    assert kinds.count("ckpt") == 1
+    assert not [d for d in os.listdir(jdir)
+                if d == "ckpt-00001"]      # partial set cleaned up
+
+def test_journal_op_records_and_auto_checkpoint(tmp_path, monkeypatch):
+    """MRTPU_JOURNAL alone arms the programmatic journal (via the
+    MapReduce constructor, like every other ft env knob)."""
+    monkeypatch.setenv("MRTPU_JOURNAL", str(tmp_path / "j"))
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "2")
+    mr = MapReduce()
+    keys = np.arange(100, dtype=np.uint64) % 7
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, keys))
+    mr.collate()
+    mr.reduce(lambda k, vs, kv, p: kv.add(k, len(vs)))
+    mr.sort_keys(1)
+    recs = ft.read_journal(str(tmp_path / "j"))
+    kinds = [r["kind"] for r in recs]
+    ops = [r["op"] for r in recs if r["kind"] == "op"]
+    assert "map" in ops and "convert" in ops and "sort_keys" in ops
+    assert "auto_ckpt" in kinds        # every-2-ops trigger fired
+    ck = ft.latest_checkpoint(str(tmp_path / "j"))
+    assert ck is not None
+    mr2 = MapReduce()
+    mr2.load(ck)
+    assert mr2.kv is not None or mr2.kmv is not None
+
+
+def _write_script_inputs(tmp_path):
+    d1 = tmp_path / "w1.txt"
+    d1.write_bytes(b"apple banana apple cherry banana apple " * 30)
+    d2 = tmp_path / "w2.txt"
+    d2.write_bytes(b"dog cat dog bird cat dog emu " * 25)
+    return str(d1), str(d2)
+
+
+def _script(d1, d2, o1, o2):
+    return (f"mr a\n"
+            f"wordfreq 3 -i {d1} -o {o1} NULL\n"
+            f"wordfreq 3 -i {d2} -o {o2} NULL\n")
+
+
+def test_kill_and_resume_reproduces_identical_output(tmp_path,
+                                                     monkeypatch):
+    """Crash-at-any-point safety: a fatal injected fault kills the
+    script after its first command checkpointed; ft.resume replays the
+    journal from the last durable checkpoint and the final outputs are
+    byte-identical to a fault-free run.  (Resume reads ONLY disk state
+    — journal + checkpoints — which is what makes the in-process
+    'kill' equivalent to kill -9.)"""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "journal")
+
+    # fault-free reference
+    c1, c2 = str(tmp_path / "o1.clean"), str(tmp_path / "o2.clean")
+    OinkScript(screen=False).run_string(_script(d1, d2, c1, c2))
+
+    # journaled run killed during command 2 (probe 2 of ingest.read)
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, after=1,
+                max_faults=1)
+    k1, k2 = str(tmp_path / "o1.kill"), str(tmp_path / "o2.kill")
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    with pytest.raises(InjectedFatal):
+        OinkScript(screen=False).run_string(_script(d1, d2, k1, k2))
+    assert os.path.exists(k1) and not os.path.exists(k2)
+    kinds = [r["kind"] for r in ft.read_journal(jdir)]
+    assert kinds.count("ckpt") >= 1 and "begin" in kinds
+
+    # resume with faults disarmed: only the un-checkpointed tail reruns
+    ft.reset()
+    monkeypatch.delenv("MRTPU_JOURNAL")
+    s = ft.resume(jdir)
+    assert open(k2).read() == open(c2).read()
+    assert open(k1).read() == open(c1).read()
+    assert "a" in s.obj.named          # the `mr a` builtin re-ran
+    # the resumed run journaled into the same dir (resumable again)
+    kinds = [r["kind"] for r in ft.read_journal(jdir)]
+    assert "resume" in kinds
+    assert kinds.count("ckpt") >= 2
+
+
+def test_resume_without_checkpoint_replays_from_scratch(tmp_path,
+                                                        monkeypatch):
+    """A crash before the first checkpoint resumes by replaying the
+    whole script (nothing durable to restore)."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "journal0")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "5")
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, max_faults=1)
+    o1, o2 = str(tmp_path / "p1"), str(tmp_path / "p2")
+    with pytest.raises(InjectedFatal):
+        OinkScript(screen=False).run_string(_script(d1, d2, o1, o2))
+    ft.reset()
+    monkeypatch.delenv("MRTPU_JOURNAL")
+    ft.resume(jdir)
+    c1, c2 = str(tmp_path / "q1"), str(tmp_path / "q2")
+    OinkScript(screen=False).run_string(_script(d1, d2, c1, c2))
+    assert open(o1).read() == open(c1).read()
+    assert open(o2).read() == open(c2).read()
+
+
+def test_oink_resume_builtin(tmp_path, monkeypatch):
+    """The script-level entry point: `resume <dir>` inside a fresh
+    interpreter replays the journal (the operator runbook path)."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "jr")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, after=1,
+                max_faults=1)
+    o1, o2 = str(tmp_path / "r1"), str(tmp_path / "r2")
+    with pytest.raises(InjectedFatal):
+        OinkScript(screen=False).run_string(_script(d1, d2, o1, o2))
+    ft.reset()
+    monkeypatch.delenv("MRTPU_JOURNAL")
+    s = OinkScript(screen=False)
+    s.run_string(f"resume {jdir}\n")
+    assert os.path.exists(o2)
+    with pytest.raises(MRError):
+        s.one("resume")                # arity check
+
+
+def test_resume_replays_named_mr_from_skipped_command(tmp_path,
+                                                      monkeypatch):
+    """A named-MR command (`freq print`) whose MR was registered by a
+    SKIPPED command's -o must replay: the skip counter counts any
+    non-builtin word, and the restore recreates the name."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "jn")
+    script = (f"wordfreq 3 -i {d1} -o NULL freq\n"
+              f"freq stats 0\n"
+              f"shell mkdir {tmp_path}/mkd\n"
+              f"wordfreq 3 -i {d2} -o {tmp_path}/n2 NULL\n")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, after=1,
+                max_faults=1)
+    with pytest.raises(InjectedFatal):
+        OinkScript(screen=False).run_string(script)
+    ft.reset()
+    monkeypatch.delenv("MRTPU_JOURNAL")
+    s = ft.resume(jdir)
+    assert "freq" in s.obj.named           # restored from the ckpt
+    assert os.path.exists(str(tmp_path / "n2"))
+
+
+def test_spill_only_chaos_keeps_ingest_fast_path():
+    """Arming non-ingest sites must not flip the ingest paths into
+    their buffered/materializing mode (the lazy-chunk property)."""
+    from gpu_mapreduce_tpu.ft.retry import ingest_active
+    ft.schedule(site="spill.write", rate=0.01)
+    assert not ingest_active("fail")
+    ft.schedule(site="ingest.read", rate=0.01)
+    assert ingest_active("fail")
+
+
+def test_unbudgeted_transient_error_not_reported_as_exhausted(
+        word_corpus):
+    """Injection armed, MRTPU_RETRY unset: a transient map-input error
+    propagates as the plain wrapped MRError — never as a 'retry budget
+    exhausted' claim about a policy that was never enabled."""
+    files, _ = word_corpus
+    ft.schedule(site="ingest.tokenize", rate=1.0, max_faults=1)
+    mr = MapReduce()
+    with pytest.raises(MRError) as ei:
+        mr.map_files(list(files), lambda i, f, kv, p: kv.add(b"x", 1))
+    assert "exhausted" not in str(ei.value)
+    assert all(o != "exhausted" for _, o in ft.retries_snapshot())
+
+
+def test_resume_missing_journal_raises():
+    with pytest.raises(MRError, match="no journal"):
+        ft.resume("/nonexistent/journal/dir")
+
+
+def test_resume_with_journal_env_still_set(tmp_path, monkeypatch):
+    """The runbook footgun: resuming WITHOUT unsetting MRTPU_JOURNAL
+    (same dir) must not write a bogus begin for the one-line resume
+    script — begin is lazy, so the journal's real begin stays the
+    latest and the resume replays the original script, resumably."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "je")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, after=1,
+                max_faults=1)
+    o1, o2 = str(tmp_path / "e1"), str(tmp_path / "e2")
+    with pytest.raises(InjectedFatal):
+        OinkScript(screen=False).run_string(_script(d1, d2, o1, o2))
+    ft.clear_faults()
+    # env var STILL SET, same dir — what an operator actually types
+    s = OinkScript(screen=False)
+    s.run_string(f"resume {jdir}\n")
+    assert os.path.exists(o2)
+    begins = [r for r in ft.read_journal(jdir) if r["kind"] == "begin"]
+    assert len(begins) == 1            # no bogus resume-script begin
+    assert begins[0]["lines"][0].strip() != f"resume {jdir}"
+
+
+def test_injected_checkpoint_fault_never_kills_journaled_run(
+        tmp_path, monkeypatch):
+    """Any-kind injected fault at checkpoint.save with no budget: the
+    OPTIONAL auto-checkpoint round is skipped, the run survives."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "jc")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    ft.schedule(site="checkpoint.save", kind="runtime", rate=1.0,
+                max_faults=1)
+    o1, o2 = str(tmp_path / "c1"), str(tmp_path / "c2")
+    OinkScript(screen=False).run_string(_script(d1, d2, o1, o2))
+    assert os.path.exists(o1) and os.path.exists(o2)
+    kinds = [r["kind"] for r in ft.read_journal(jdir)]
+    assert kinds.count("ckpt") == 1    # round 1 skipped, round 2 landed
+
+
+def test_quarantine_skip_at_discovery_and_balance_time(word_corpus,
+                                                       monkeypatch):
+    """A file failing at findfiles/balance_by_bytes gets the same
+    onfault=skip disposition as a task-time failure — which stage
+    notices must not decide whether the run survives."""
+    files, oracle = word_corpus
+    import gpu_mapreduce_tpu.parallel.ingest as ing
+    real = os.path.getsize
+    bad = files[1]
+    with open(bad, "rb") as f:
+        bad_words = collections.Counter(read_words(f.read()))
+    monkeypatch.setattr(
+        ing.os.path, "getsize",
+        lambda p: (_ for _ in ()).throw(OSError(5, "I/O error", p))
+        if p == bad else real(p))
+
+    def fileread(itask, fname, kv, ptr):
+        with open(fname, "rb") as f:
+            ws = read_words(f.read())
+        kv.add_batch(ws, np.ones(len(ws), np.int64))
+
+    mr = MapReduce(make_mesh(4), onfault="skip")
+    n = mr.map_files(list(files), fileread)
+    assert n == sum((oracle - bad_words).values())
+    q = ft.quarantine_snapshot()
+    assert q["count"] == 1 and q["records"][0]["file"] == bad
+    # discovery of a wholly-missing path quarantines too
+    ft.reset()
+    mr = MapReduce(onfault="skip")
+    n = mr.map_files(list(files) + ["/nonexistent/ghost.txt"], fileread)
+    assert n == sum(oracle.values())
+    assert ft.quarantine_snapshot()["records"][0]["file"] == \
+        "/nonexistent/ghost.txt"
+
+
+def test_second_script_run_resumes_with_per_script_numbering(
+        tmp_path, monkeypatch):
+    """One interpreter running two scripts: command numbering restarts
+    at each begin, so a crash in script 2 resumes script 2's commands
+    (not an over-skipped ghost of script 1's)."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "j2s")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    o = {k: str(tmp_path / k) for k in ("a1", "a2", "b1", "b2")}
+    script2 = (f"mr b\n"
+               f"wordfreq 3 -i {d1} -o {o['b1']} NULL\n"
+               f"wordfreq 3 -i {d2} -o {o['b2']} NULL\n")
+    s = OinkScript(screen=False)
+    s.run_string(_script(d1, d2, o["a1"], o["a2"]))     # script 1 OK
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, after=1,
+                max_faults=1)
+    with pytest.raises(InjectedFatal):                  # script 2 dies
+        s.run_string(script2)
+    ft.reset()
+    monkeypatch.delenv("MRTPU_JOURNAL")
+    r = ft.resume(jdir)
+    assert os.path.exists(o["b2"])
+    assert open(o["b2"]).read() == open(o["a2"]).read()
+    assert "b" in r.obj.named
+
+
+def test_new_interpreter_does_not_close_live_script_journal(
+        tmp_path, monkeypatch):
+    """Constructing a second OinkScript (env armed) must not close the
+    journal a live first interpreter still appends to."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    d1, d2 = _write_script_inputs(tmp_path)
+    monkeypatch.setenv("MRTPU_JOURNAL", str(tmp_path / "jl"))
+    s1 = OinkScript(screen=False)
+    OinkScript(screen=False)       # would close s1's journal if buggy
+    s1.run_string(_script(d1, d2, str(tmp_path / "l1"),
+                          str(tmp_path / "l2")))   # appends fine
+    assert os.path.exists(str(tmp_path / "l2"))
+
+
+def test_ckpt_gc_keeps_fresh_low_numbered_dirs(tmp_path, monkeypatch):
+    """begin() restarts per-script numbering, so a re-run in the same
+    journal dir writes LOW-numbered ckpt dirs; GC must keep them (by
+    mtime) over the previous run's stale high-numbered ones — resume
+    points at the fresh one."""
+    from gpu_mapreduce_tpu.oink import OinkScript
+    from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+    d1, d2 = _write_script_inputs(tmp_path)
+    jdir = str(tmp_path / "jgc")
+    monkeypatch.setenv("MRTPU_JOURNAL", jdir)
+    monkeypatch.setenv("MRTPU_CKPT_EVERY", "1")
+    o = str(tmp_path / "gc")
+    # script 1: THREE commands → ckpt-00001..3 (keep=2 leaves 2 and 3)
+    s = OinkScript(screen=False)
+    s.run_string(f"mr a\n"
+                 f"wordfreq 3 -i {d1} -o {o}.a NULL\n"
+                 f"wordfreq 3 -i {d2} -o {o}.b NULL\n"
+                 f"wordfreq 3 -i {d1} -o {o}.c NULL\n")
+    # script 2 (same dir): crash after command 1 — its single fresh
+    # ckpt-00001 must survive GC despite sorting below the stale
+    # ckpt-00002/3 dirs left by script 1
+    ft.schedule(site="ingest.read", kind="fatal", rate=1.0, after=1,
+                max_faults=1)
+    with pytest.raises(InjectedFatal):
+        s.run_string(f"mr b\n"
+                     f"wordfreq 3 -i {d1} -o {o}.d NULL\n"
+                     f"wordfreq 3 -i {d2} -o {o}.e NULL\n"
+                     f"wordfreq 3 -i {d1} -o {o}.f NULL\n")
+    ft.reset()
+    monkeypatch.delenv("MRTPU_JOURNAL")
+    r = ft.resume(jdir)                 # must load the FRESH checkpoint
+    assert os.path.exists(f"{o}.f")
+    assert "b" in r.obj.named
+
+
+def test_unknown_retry_site_rejected(monkeypatch, capsys):
+    """A typo'd MRTPU_RETRY site must warn loudly, never silently
+    disarm the protection the operator thinks is on."""
+    with pytest.raises(ValueError, match="unknown retry site"):
+        ft.set_budget("ingest.raed", 3)
+    with pytest.raises(ValueError):
+        ft.parse_retry("ingest.raed=3")
+    monkeypatch.setenv("MRTPU_RETRY", "ingest.raed=3")
+    MapReduce()
+    assert "MRTPU_RETRY ignored" in capsys.readouterr().err
+
+
+def test_programmatic_budget_survives_env_respec(monkeypatch):
+    ft.set_budget("spill.read", 3)
+    monkeypatch.setenv("MRTPU_RETRY", "ingest.read=2")
+    MapReduce()
+    assert ft.budget("spill.read") == 3    # programmatic survives
+    assert ft.budget("ingest.read") == 2
+    monkeypatch.setenv("MRTPU_RETRY", "")
+    MapReduce()
+    assert ft.budget("spill.read") == 3    # env respec drops env only
+    assert ft.budget("ingest.read") == 0
+
+
+# ---------------------------------------------------------------------------
+# stats surface
+# ---------------------------------------------------------------------------
+
+def test_stats_ft_section_shape():
+    st = MapReduce().stats()["ft"]
+    assert set(st) == {"retries", "faults_injected", "quarantined",
+                       "budgets", "journal"}
+    assert st["journal"] is None
+    ft.set_budget("spill.read", 2)
+    st = MapReduce().stats()["ft"]
+    assert st["budgets"] == {"spill.read": 2}

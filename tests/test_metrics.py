@@ -378,6 +378,59 @@ def test_flight_dump_on_sigusr1(tmp_path, obs_state):
     assert any(e["name"] == "sort_keys" for e in doc["spans"])
 
 
+def test_exhausted_retry_budget_dumps_flight_with_ft_span(tmp_path,
+                                                          obs_state,
+                                                          monkeypatch):
+    """The ft/ ↔ PR-3 flight path: an exhausted retry budget raises
+    MRError, and the flight-recorder artifact's trace tail contains the
+    failing ``ft.retry`` span (site + outcome=exhausted) plus the
+    mrtpu_retries_total counters."""
+    import sys
+
+    from gpu_mapreduce_tpu import ft
+    import gpu_mapreduce_tpu.ft.retry as ftr
+    from gpu_mapreduce_tpu.core.runtime import MRError
+    from gpu_mapreduce_tpu.obs import flight
+
+    _, metrics = obs_state
+    metrics.enable_metrics(flight=False)
+    rec = flight.enable(dir=str(tmp_path))
+    monkeypatch.setattr(ftr, "_sleep", lambda s: None)
+    ft.reset()
+    ft.set_budget("spill.read", 2)
+    try:
+        _traced_ops()
+
+        def torn_block():
+            raise OSError("torn block read")
+
+        try:
+            ft.retry_call("spill.read", torn_block, detail="run-7.k.npy")
+            raise AssertionError("budget should exhaust")
+        except MRError:
+            exc_type, exc, tb = sys.exc_info()
+        sys.excepthook(exc_type, exc, tb)   # what interpreter exit runs
+        doc = json.load(open(rec.last_dump))
+        assert doc["reason"] == "unhandled:MRError"
+        tail = doc["spans"][-3:]
+        ft_spans = [e for e in tail if e["name"] == "ft.retry"]
+        assert ft_spans, [e["name"] for e in doc["spans"]]
+        args = ft_spans[-1]["args"]
+        assert args["site"] == "spill.read"
+        assert args["outcome"] == "exhausted"
+        assert args["detail"] == "run-7.k.npy"
+        # the same failure is counted in the registry (collector pull)
+        snap = metrics.snapshot()
+        got = {(s["labels"]["site"], s["labels"]["outcome"]):
+               s["value"]
+               for s in snap["mrtpu_retries_total"]["samples"]}
+        assert got[("spill.read", "exhausted")] == 1
+        assert got[("spill.read", "retry")] == 2
+        assert "mrtpu_retries_total" in doc["metrics"]
+    finally:
+        ft.reset()
+
+
 def test_flight_dump_never_raises(tmp_path, obs_state):
     from gpu_mapreduce_tpu.obs import flight
 
