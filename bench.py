@@ -310,6 +310,7 @@ def _knobs():
 
 FUSE_MODE = None   # --fuse {0,1,ab} (or BENCH_FUSE); None = skip A/B
 OVERLAP_MODE = None  # --overlap {0,1,ab} (or BENCH_OVERLAP); None = skip
+SERVE_MODE = False   # --serve (or BENCH_SERVE=1): daemon cold/warm A/B
 GATE = False       # --gate: after the run, regress-check against the
 #                    BENCH_r*.json trailing baseline (scripts/
 #                    bench_compare.py) and exit nonzero on a trip
@@ -483,6 +484,51 @@ def overlap_ab_record(mode: str, paths) -> dict:
     return out
 
 
+def serve_ab_record() -> dict:
+    """``--serve``: submit the identical wordfreq workload TWICE through
+    an in-process serve/ daemon and record cold-vs-warm wall time plus
+    dispatch and plan-cache counts — the resident-daemon story: the
+    second request must hit the shared plan cache and recompile nothing
+    (``warm.plan_misses == 0``; doc/serve.md)."""
+    import shutil
+    import tempfile
+    from gpu_mapreduce_tpu.serve import Server, ServeClient
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    srv = None
+    try:
+        corpus = os.path.join(tmp, "corpus.txt")
+        with open(corpus, "w") as f:
+            # deterministic ~2 MB corpus: the A/B measures compile
+            # amortization across requests, not ingest throughput
+            for i in range(300000):
+                f.write(f"w{i % 4096} ")
+        srv = Server(port=0, workers=1,
+                     state_dir=os.path.join(tmp, "state"))
+        port = srv.start()
+        c = ServeClient.local(port)
+        script = (f"variable files index {corpus}\n"
+                  f"set fuse 1\n"
+                  f"wordfreq 5 -i v_files\n")
+        out = {}
+        for phase in ("cold", "warm"):
+            res = c.wait(c.submit(script=script, tenant="bench")["id"],
+                         timeout=600)
+            if res.get("status") != "done":
+                raise RuntimeError(f"serve {phase} run failed: "
+                                   f"{res.get('error')}")
+            pc = res["meta"]["plan_cache"]["plan"]
+            out[phase] = {"wall_s": res["meta"]["wall_s"],
+                          "dispatches": res["meta"]["dispatches"],
+                          "plan_misses": pc["misses"],
+                          "plan_hits": pc["hits"]}
+        out["warm_skipped_compiles"] = out["warm"]["plan_misses"] == 0
+        return out
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_bench(engine, backend_err):
     total_mb = int(os.environ.get("BENCH_MB", "256"))
     skew = os.environ.get("BENCH_SKEW", "0") == "1"
@@ -582,6 +628,14 @@ def run_bench(engine, backend_err):
         except Exception:
             detail["exec_ab"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if SERVE_MODE:
+        # --serve: cold-vs-warm daemon A/B (serve/); failures must not
+        # cost the headline metric line
+        try:
+            detail["serve_ab"] = serve_ab_record()
+        except Exception:
+            detail["serve_ab"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     try:
         print(json.dumps({"detail": detail}), file=sys.stderr)
     except Exception:
@@ -601,7 +655,7 @@ def run_bench(engine, backend_err):
 
 
 def main():
-    global FUSE_MODE, OVERLAP_MODE, GATE
+    global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, GATE
     argv = sys.argv[1:]
     GATE = "--gate" in argv or os.environ.get("BENCH_GATE") == "1"
     if "--fuse" in argv:
@@ -619,6 +673,8 @@ def main():
     if OVERLAP_MODE not in (None, "0", "1", "ab"):
         raise SystemExit(
             f"--overlap takes 0, 1 or ab, got {OVERLAP_MODE!r}")
+    SERVE_MODE = "--serve" in argv or \
+        os.environ.get("BENCH_SERVE") == "1"
     backend_err = None
     try:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))

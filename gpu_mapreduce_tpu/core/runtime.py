@@ -25,7 +25,9 @@ TPU reinterpretations (documented, not silently dropped):
 
 from __future__ import annotations
 
+import contextlib
 import os
+import threading
 import time
 import warnings
 from dataclasses import dataclass, field
@@ -126,12 +128,20 @@ class Counters:
         with self._lock:
             for name, delta in deltas.items():
                 setattr(self, name, getattr(self, name) + delta)
+        if "wsize" in deltas or "rsize" in deltas:
+            acct = getattr(_ACCOUNT_TLS, "acct", None)
+            if acct is not None:
+                acct.note_io(deltas.get("wsize", 0),
+                             deltas.get("rsize", 0))
 
     def mem(self, delta: int):
         with self._lock:
             self.msize += delta
             if self.msize > self.msizemax:
                 self.msizemax = self.msize
+        acct = getattr(_ACCOUNT_TLS, "acct", None)
+        if acct is not None:
+            acct.charge(delta)
 
     def snapshot(self) -> dict:
         """Consistent copy of every counter field — the structured twin
@@ -142,6 +152,93 @@ class Counters:
                     "cssize": self.cssize, "crsize": self.crsize,
                     "cspad": self.cspad, "commtime": self.commtime,
                     "ndispatch": self.ndispatch}
+
+
+class PageAccount:
+    """Per-tenant frame-residency accounting (serve/budget.py).
+
+    The enforcement half of a tenant budget is the existing page
+    machinery — a session's MRs are created with ``maxpage``/``memsize``
+    /``outofcore`` derived from the tenant's allowance, so an
+    over-budget dataset spills through ``core/dataset.py`` exactly like
+    any memory-constrained run.  This class is the *attribution* half:
+    bytes charged through :meth:`Counters.mem` while a tenant scope is
+    installed land here, giving the serve/ daemon a live per-tenant
+    ``pages in use`` reading (the ``mrtpu_tenant_pages{tenant}`` gauge)
+    without a second accounting path in the datasets.
+
+    Attribution is thread-scoped (:func:`page_account_scope`): bytes
+    charged from helper threads a session spawns itself (ingest pool
+    workers) bill the global counters but not the tenant — frame
+    consolidation happens on the session thread, so residency totals
+    stay accurate (doc/serve.md)."""
+
+    __slots__ = ("tenant", "page_bytes", "limit_pages", "bytes_in_use",
+                 "hi_water", "spilled_bytes", "reread_bytes", "_lock")
+
+    def __init__(self, tenant: str, page_bytes: int,
+                 limit_pages: int = 0):
+        self.tenant = tenant
+        self.page_bytes = max(1, int(page_bytes))
+        self.limit_pages = int(limit_pages)      # 0 = unlimited
+        self.bytes_in_use = 0
+        self.hi_water = 0
+        self.spilled_bytes = 0       # budget-enforcement evidence: what
+        self.reread_bytes = 0        # THIS tenant paid in disk traffic
+        self._lock = threading.Lock()
+
+    def charge(self, delta: int) -> None:
+        with self._lock:
+            self.bytes_in_use = max(0, self.bytes_in_use + int(delta))
+            if self.bytes_in_use > self.hi_water:
+                self.hi_water = self.bytes_in_use
+
+    def note_io(self, wsize: int, rsize: int) -> None:
+        with self._lock:
+            self.spilled_bytes += int(wsize)
+            self.reread_bytes += int(rsize)
+
+    def pages_in_use(self) -> float:
+        with self._lock:
+            return self.bytes_in_use / self.page_bytes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"tenant": self.tenant,
+                    "bytes_in_use": self.bytes_in_use,
+                    "hi_water": self.hi_water,
+                    "spilled_bytes": self.spilled_bytes,
+                    "reread_bytes": self.reread_bytes,
+                    "page_bytes": self.page_bytes,
+                    "pages_in_use": round(self.bytes_in_use
+                                          / self.page_bytes, 4),
+                    "limit_pages": self.limit_pages}
+
+
+_ACCOUNT_TLS = threading.local()
+
+
+def set_page_account(acct: Optional["PageAccount"]
+                     ) -> Optional["PageAccount"]:
+    """Install ``acct`` as THIS thread's tenant attribution target;
+    returns the previous one (callers restore it)."""
+    prev = getattr(_ACCOUNT_TLS, "acct", None)
+    _ACCOUNT_TLS.acct = acct
+    return prev
+
+
+def current_page_account() -> Optional["PageAccount"]:
+    return getattr(_ACCOUNT_TLS, "acct", None)
+
+
+@contextlib.contextmanager
+def page_account_scope(acct: Optional["PageAccount"]):
+    """``with page_account_scope(acct):`` — scoped install/restore."""
+    prev = set_page_account(acct)
+    try:
+        yield acct
+    finally:
+        set_page_account(prev)
 
 
 class Timer:

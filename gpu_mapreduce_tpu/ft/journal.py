@@ -59,7 +59,21 @@ class Journal:
         self.dir = dir
         os.makedirs(dir, exist_ok=True)
         self.path = os.path.join(dir, _FILE)
+        # seal a torn tail from a previous crash BEFORE appending: a
+        # partial final line with no newline (kill -9 mid-append) would
+        # otherwise MERGE with our first record into one unparseable
+        # line, losing that record to every future read
+        sealed = True
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                sealed = f.read(1) == b"\n"
+        except (OSError, ValueError):
+            pass        # missing or empty file — nothing to seal
         self._f = open(self.path, "a")
+        if not sealed:
+            self._f.write("\n")
+            self._f.flush()
         self.script_mode = script_mode
         self.every = max(1, every if every is not None
                          else env_knob("MRTPU_CKPT_EVERY", int, 5))
@@ -267,10 +281,18 @@ def note_op(mr, op: str, n=None) -> None:
     j = _ACTIVE
     if j is None:
         return
-    j.note_op(op, **({"n": int(n)} if isinstance(n, (int, float))
-                     else {}))
-    if not j.script_mode:
-        j.auto_checkpoint(mr)
+    try:
+        j.note_op(op, **({"n": int(n)} if isinstance(n, (int, float))
+                         else {}))
+        if not j.script_mode:
+            j.auto_checkpoint(mr)
+    except ValueError:
+        # the journal closed between the _ACTIVE read and the append
+        # (a resume_into finishing on another thread, ft.reset, an
+        # env-cleared disarm — serve/ worker pools run concurrently).
+        # Op records and OPTIONAL checkpoints are best-effort; a lost
+        # one must never fail the op that reported it
+        return
 
 
 # ---------------------------------------------------------------------------
@@ -289,7 +311,14 @@ def read_journal(dir: str) -> List[dict]:
                 try:
                     out.append(json.loads(ln))
                 except ValueError:
-                    break    # torn final line from a crash mid-append
+                    # torn line from a crash mid-append.  SKIP, don't
+                    # stop: a journal reopened after a kill -9 keeps
+                    # appending past its sealed torn tail (Journal
+                    # init), so records AFTER the tear are valid and
+                    # replay depends on them; the torn record itself
+                    # was never durable, so treating it as absent is
+                    # the records-follow-facts contract
+                    continue
             return out
     except FileNotFoundError:
         raise MRError(f"no journal under {dir!r}")

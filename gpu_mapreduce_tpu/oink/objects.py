@@ -56,6 +56,7 @@ class ObjectManager:
         self._anon_names: List[str] = []
         self._anon_counter = 0
         self.defaults: Dict[str, object] = {}
+        self.pinned: Dict[str, object] = {}
         self.inputs: List[InputDescriptor] = []
         self.outputs: List[OutputDescriptor] = []
 
@@ -63,7 +64,22 @@ class ObjectManager:
     def set_default(self, name: str, value):
         if name not in self.MR_SETTINGS:
             raise MRError(f"unknown set parameter {name!r}")
+        if name in self.pinned and value != self.pinned[name]:
+            # serve/ tenancy: budget settings the daemon seeded are not
+            # the tenant's to change — a script `set maxpage 100000`
+            # must fail its session loudly, not escape its allowance
+            raise MRError(f"setting {name!r} is pinned by the server "
+                          f"(tenant budget; doc/serve.md)")
         self.defaults[name] = value
+
+    def pin(self, **settings):
+        """Install settings as defaults AND lock them: later
+        ``set_default`` calls (the script `set` command) for these keys
+        raise instead of overriding — the serve/ tenant-budget
+        enforcement point."""
+        for name, value in settings.items():
+            self.set_default(name, value)
+            self.pinned[name] = value
 
     # -- MR lifecycle ------------------------------------------------------
     def create_mr(self) -> MapReduce:
@@ -183,6 +199,7 @@ class ObjectManager:
             return
         d = self.outputs[index - 1]
         if d.path is not None:
+            _ensure_parent(d.path)
             fr = _mesh_frame(mr)
             if fr is not None and fr.nprocs > 1:
                 for p in range(fr.nprocs):
@@ -209,6 +226,18 @@ class ObjectManager:
                             printer(k, v, fp)
         if d.mr_name is not None:
             self.name_mr(d.mr_name, mr)
+
+
+def _ensure_parent(path: str) -> None:
+    """Create an -o path's parent directory: `set prepend sub` (and the
+    serve/ session re-rooting built on it) names nested output paths
+    whose directories the script never mkdir'd."""
+    parent = os.path.dirname(path)
+    if parent:
+        try:
+            os.makedirs(parent, exist_ok=True)
+        except OSError:
+            pass    # the open() that follows reports the real error
 
 
 def _mesh_frame(mr: MapReduce):

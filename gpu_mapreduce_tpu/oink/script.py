@@ -38,11 +38,15 @@ class OinkScript:
     """One interpreter instance: variable table + object manager + log.
 
     ``comm``: optional mesh (forwarded to every MR the script creates).
-    ``screen``: None → stdout, False → silent, or a file-like."""
+    ``screen``: None → stdout, False → silent, or a file-like.
+    ``obj``: a caller-owned :class:`ObjectManager` — the serve/ daemon
+    hands each session its own namespace (pre-loaded with tenant budget
+    defaults), so two concurrent sessions both running ``mr x`` never
+    collide; when given, its ``comm`` wins."""
 
     def __init__(self, comm=None, screen=None, logfile: Optional[str] = None,
-                 world=None):
-        self.obj = ObjectManager(comm=comm)
+                 world=None, obj: Optional[ObjectManager] = None):
+        self.obj = obj if obj is not None else ObjectManager(comm=comm)
         self.variables = Variables(world=world)
         self.dispatch = MRScriptDispatch(self.obj, self.variables)
         self.screen: Optional[TextIO]
@@ -386,7 +390,14 @@ class OinkScript:
         self.obj.cleanup()
         for name in list(self.obj.named):
             self.obj.delete_mr(name)
+        defaults = dict(self.obj.defaults)
+        pinned = dict(self.obj.pinned)
         self.obj = ObjectManager(comm=self.obj.comm)
+        # `set` defaults — and the serve/ tenant-budget pins — survive
+        # a clear: a script-level clear must not be able to shed the
+        # budget wiring the daemon seeded (doc/serve.md)
+        self.obj.defaults.update(defaults)
+        self.obj.pinned.update(pinned)
         self.dispatch = MRScriptDispatch(self.obj, self.variables)
 
     def cmd_echo(self, args):
@@ -546,6 +557,20 @@ class OinkScript:
                 # string-valued ft/ policy (fail|retry|skip)
                 self.obj.set_default("onfault", val)
             elif key == "prepend":
+                root = getattr(self, "_path_root", None)
+                if root is not None:
+                    # serve/ sessions anchor ALL relative output under
+                    # their own directory: the script's prepend idiom
+                    # keeps working, re-rooted inside the sandbox; an
+                    # absolute prepend would silently move -o files
+                    # out of the session (losing them from the result
+                    # and the crash-replay golden), so it fails loudly
+                    if os.path.isabs(val):
+                        raise MRError(
+                            "absolute prepend is pinned by the server "
+                            "(session outputs stay in the session "
+                            "directory; doc/serve.md)")
+                    val = os.path.join(root, val)
                 self._path_prepend = val
             elif key == "substitute":
                 self._path_substitute = int(val)

@@ -117,6 +117,25 @@ def cache_stats() -> dict:
     return out
 
 
+def stats_delta(before: dict, after: Optional[dict] = None) -> dict:
+    """Per-request compile-cache deltas: ``{cache: {hits, misses,
+    evictions}}`` between two :func:`cache_stats` snapshots (``after``
+    defaults to a fresh snapshot).  The caches are process-global —
+    PR 2's LRU is a fleet-wide warm cache under the serve/ daemon — so
+    a single request's "did this recompile?" question is only
+    answerable as a delta: the serve/ session runner stamps one into
+    every result (``misses == 0`` on a warm identical request is the
+    no-recompile assertion bench's ``detail.serve_ab`` and the
+    acceptance test make)."""
+    after = cache_stats() if after is None else after
+    out = {}
+    for cname, a in after.items():
+        b = before.get(cname, {})
+        out[cname] = {k: a.get(k, 0) - b.get(k, 0)
+                      for k in ("hits", "misses", "evictions")}
+    return out
+
+
 # ---------------------------------------------------------------------------
 # plan history: the last few executed plans, described, for dump_plan /
 # scripts/plan_dump.py (the trace ring's analog for whole plans)

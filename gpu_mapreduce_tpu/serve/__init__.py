@@ -1,0 +1,21 @@
+"""serve/ — the multi-tenant MR-as-a-service daemon.
+
+Turns the one-script-one-process model inside out: a resident
+:class:`~.daemon.Server` keeps the expensive state warm (backend/mesh
+init, the plan/ compiled-plan LRU, shuffle jit caches, interned
+dictionaries) and executes OINK scripts / JSON op batches submitted
+over the obs/httpd loopback listener as isolated, journaled,
+budget-scoped sessions.  ``python -m gpu_mapreduce_tpu.serve`` runs it
+standalone; ``scripts/mrctl.py`` is the operator client.  doc/serve.md
+is the contract.
+"""
+
+from .admission import AdmissionQueue
+from .budget import TenantBudgets
+from .client import ServeClient, ServeError
+from .daemon import Server
+from .session import Session, normalize_payload, run_session
+
+__all__ = ["AdmissionQueue", "TenantBudgets", "ServeClient",
+           "ServeError", "Server", "Session", "normalize_payload",
+           "run_session"]

@@ -1,0 +1,69 @@
+"""``python -m gpu_mapreduce_tpu.serve`` — run the daemon standalone.
+
+Prints one JSON line (``{"serving": <port>, ...}``) once the listener
+is up, then blocks until ``POST /v1/shutdown`` (or ``mrctl shutdown``)
+stops it.  SIGTERM drains and exits cleanly; ``kill -9`` is the case
+the journal exists for (doc/serve.md#recovery).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m gpu_mapreduce_tpu.serve",
+        description="MR-as-a-service daemon (doc/serve.md)")
+    p.add_argument("--port", type=int, default=None,
+                   help="listen port (default MRTPU_SERVE_PORT or 0 "
+                        "= ephemeral; the bound port lands in "
+                        "<state>/serve.json)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="worker pool size (default MRTPU_SERVE_WORKERS "
+                        "or 2)")
+    p.add_argument("--queue", type=int, default=None,
+                   help="admission queue capacity (default "
+                        "MRTPU_SERVE_QUEUE or 16)")
+    p.add_argument("--state", default=None,
+                   help="state directory: journal, sessions, results "
+                        "(default MRTPU_SERVE_STATE or ./mrtpu-serve)")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="build an N-device mesh at start (0 = serial "
+                        "backend)")
+    p.add_argument("--paused", action="store_true",
+                   help="admit + journal but do not execute "
+                        "(maintenance staging)")
+    args = p.parse_args(argv)
+
+    comm = None
+    if args.mesh > 0:
+        from ..parallel.mesh import make_mesh
+        comm = make_mesh(args.mesh)
+
+    from .daemon import Server
+    srv = Server(port=args.port, workers=args.workers,
+                 queue_cap=args.queue, state_dir=args.state,
+                 comm=comm, paused=args.paused or None)
+    port = srv.start()
+    print(json.dumps({"serving": port, "state": srv.state_dir,
+                      "workers": srv.nworkers, "paused": srv.paused}),
+          flush=True)
+
+    def _term(signum, frame):
+        srv.shutdown()
+
+    signal.signal(signal.SIGTERM, _term)
+    try:
+        while not srv.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

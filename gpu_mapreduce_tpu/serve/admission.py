@@ -1,0 +1,78 @@
+"""Bounded admission queue with backpressure.
+
+The daemon's front door: submissions past ``MRTPU_SERVE_QUEUE`` pending
+sessions are REJECTED at admission (HTTP 429 + ``Retry-After``) instead
+of being buffered without bound — under sustained overload the queue
+depth, not the daemon's memory, is the thing that saturates.  Recovery
+replay uses ``force=True``: a session the journal says was accepted
+must re-enter the queue even when the restart finds it already full.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+
+class AdmissionQueue:
+    """Thread-safe bounded FIFO.  ``offer`` never blocks — admission
+    control means telling the client "not now", not making it wait on
+    a server thread."""
+
+    def __init__(self, cap: int):
+        self.cap = max(1, int(cap))
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self.rejects = 0          # cumulative admission rejections
+
+    def offer(self, item, force: bool = False) -> bool:
+        with self._cv:
+            if self._closed:
+                return False
+            if len(self._q) >= self.cap and not force:
+                self.rejects += 1
+                return False
+            self._q.append(item)
+            self._cv.notify()
+            return True
+
+    def take(self, timeout: Optional[float] = None):
+        """Next session, or None on timeout / after close-and-drained.
+        A closed queue still hands out its remaining items — shutdown
+        finishes accepted work unless the process dies first (the
+        journal covers that case)."""
+        with self._cv:
+            if not self._q and not self._closed:
+                self._cv.wait(timeout)
+            if self._q:
+                return self._q.popleft()
+            return None
+
+    def reject(self) -> None:
+        """Count an admission rejection made by a caller that checked
+        capacity itself (the daemon holds its submit lock across the
+        check + journal + offer, so it probes ``full()`` rather than
+        letting ``offer`` race) — the counter mutation stays under the
+        queue's own lock either way."""
+        with self._cv:
+            self.rejects += 1
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def full(self) -> bool:
+        with self._cv:
+            return len(self._q) >= self.cap
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {"depth": len(self._q), "cap": self.cap,
+                    "rejects": self.rejects, "closed": self._closed}

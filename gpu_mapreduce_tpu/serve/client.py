@@ -1,0 +1,121 @@
+"""Thin HTTP client for the serve/ daemon (stdlib urllib only).
+
+Used by ``scripts/mrctl.py``, ``bench.py --serve``, the soak serve
+workload, and the tests — one implementation of the wire protocol so
+"what does a 429 look like" has a single answer.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from ..core.runtime import MRError
+
+
+class ServeError(MRError):
+    """Non-2xx daemon response; carries the code and Retry-After."""
+
+    def __init__(self, code: int, body: dict,
+                 retry_after: Optional[int] = None):
+        self.code = code
+        self.body = body
+        self.retry_after = retry_after
+        super().__init__(f"serve HTTP {code}: "
+                         f"{body.get('error') or body}")
+
+
+class ServeClient:
+    def __init__(self, base: str, timeout: float = 30.0):
+        self.base = base.rstrip("/")
+        self.timeout = timeout
+
+    @classmethod
+    def local(cls, port: int, **kw) -> "ServeClient":
+        return cls(f"http://127.0.0.1:{port}", **kw)
+
+    @classmethod
+    def from_state_dir(cls, state_dir: str, **kw) -> "ServeClient":
+        """Discover the daemon's bound port from ``<state>/serve.json``
+        (written atomically at start — ephemeral-port friendly)."""
+        import os
+        with open(os.path.join(state_dir, "serve.json")) as f:
+            return cls.local(int(json.load(f)["port"]), **kw)
+
+    # -- wire --------------------------------------------------------------
+    def _req(self, method: str, path: str,
+             obj: Optional[dict] = None) -> dict:
+        data = json.dumps(obj).encode() if obj is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            raw = e.read().decode(errors="replace")
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                body = {"error": raw}
+            ra = e.headers.get("Retry-After")
+            raise ServeError(e.code, body,
+                             int(ra) if ra and ra.isdigit() else None) \
+                from None
+
+    # -- API ---------------------------------------------------------------
+    def submit(self, script: Optional[str] = None,
+               ops: Optional[list] = None,
+               tenant: str = "default") -> dict:
+        body: dict = {"tenant": tenant}
+        if script is not None:
+            body["script"] = script
+        if ops is not None:
+            body["ops"] = ops
+        return self._req("POST", "/v1/jobs", body)
+
+    def jobs(self) -> list:
+        return self._req("GET", "/v1/jobs")["jobs"]
+
+    def status(self, sid: str) -> dict:
+        return self._req("GET", f"/v1/jobs/{sid}")
+
+    def result(self, sid: str) -> dict:
+        """The result record; raises ServeError(202 body) only via
+        :meth:`wait` — a not-done result returns the status summary."""
+        return self._req("GET", f"/v1/jobs/{sid}/result")
+
+    def wait(self, sid: str, timeout: float = 120.0,
+             poll_s: float = 0.05) -> dict:
+        """Poll until the session finishes; returns the result record."""
+        deadline = time.monotonic() + timeout
+        while True:
+            out = self._req("GET", f"/v1/jobs/{sid}/result")
+            if out.get("status") in ("done", "failed") or \
+                    out.get("state") in ("done", "failed"):
+                return out
+            if time.monotonic() > deadline:
+                raise ServeError(408, {"error": f"session {sid} still "
+                                       f"{out.get('state')!r} after "
+                                       f"{timeout}s"})
+            time.sleep(poll_s)
+
+    def stats(self) -> dict:
+        return self._req("GET", "/v1/stats")
+
+    def drain(self) -> dict:
+        return self._req("POST", "/v1/drain")
+
+    def shutdown(self) -> dict:
+        return self._req("POST", "/v1/shutdown")
+
+    def healthz(self) -> bool:
+        try:
+            req = urllib.request.Request(self.base + "/healthz")
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status == 200
+        except (urllib.error.URLError, OSError):
+            return False

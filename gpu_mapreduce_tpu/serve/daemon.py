@@ -1,0 +1,444 @@
+"""The MR-as-a-service daemon.
+
+One resident process holds what a cold script run pays for on every
+invocation: the initialized backend (and mesh, when one is configured),
+the process-global compiled-plan LRU and shuffle jit caches (PR 2's
+cache becomes a fleet-wide warm cache — a second identical request
+compiles NOTHING), and the interned-dictionary state of the bytes
+domain.  Requests arrive over the obs/httpd loopback listener as
+sessions (serve/session.py) through a bounded admission queue
+(serve/admission.py) into a small worker pool.
+
+Durability: every ACCEPTED session lands in an fsync'd ft/ journal
+(``<state>/journal.jsonl``) before the client sees its 202, and its
+completion is recorded after the result file is durably on disk — so a
+``kill -9`` at any point leaves a state directory from which a
+restarted daemon replays exactly the accepted-but-unfinished sessions,
+in admission order, resuming any that were mid-run from their last
+auto-checkpoint (doc/serve.md#recovery).
+
+HTTP API (all JSON; see doc/serve.md):
+
+* ``POST /v1/jobs``               — submit ``{"script"| "ops", "tenant"}``
+  → 202 ``{"id", "state"}``; 429 + ``Retry-After`` when the queue is
+  full; 503 when draining.
+* ``GET  /v1/jobs``               — session summaries.
+* ``GET  /v1/jobs/<id>``          — one session's status.
+* ``GET  /v1/jobs/<id>/result``   — the result record (202 while
+  pending/running).
+* ``GET  /v1/stats``              — queue/sessions/tenants/plan-cache.
+* ``POST /v1/drain``              — stop admitting, keep executing.
+* ``POST /v1/shutdown``           — drain, finish the queue, stop.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core.runtime import MRError
+from ..utils.env import env_knob
+from .admission import AdmissionQueue
+from .budget import TenantBudgets
+from .session import (DONE, FAILED, QUEUED, RUNNING, Session,
+                      atomic_write_json, normalize_payload, run_session)
+
+_CURRENT: Optional["Server"] = None     # the metrics collector's target
+
+
+def _collect_serve(reg) -> None:
+    """obs/metrics collector: refresh the serve gauges at scrape time."""
+    srv = _CURRENT
+    if srv is None:
+        return
+    reg.gauge("mrtpu_sessions_active",
+              "sessions currently executing on serve/ workers"
+              ).set(srv.active_count())
+    reg.gauge("mrtpu_serve_queue_depth",
+              "sessions admitted but not yet running"
+              ).set(srv.queue.depth())
+    g = reg.gauge("mrtpu_tenant_pages",
+                  "per-tenant dataset pages currently resident "
+                  "(bytes_in_use / memsize)", ("tenant",))
+    for tenant, snap in srv.budgets.snapshot().items():
+        g.set(snap["pages_in_use"], tenant=tenant)
+
+
+class Server:
+    """The daemon object.  ``start()`` recovers the state directory,
+    mounts the HTTP routes, and spins up the worker pool; it is safe to
+    embed in-process (tests, bench.py --serve) or drive via
+    ``python -m gpu_mapreduce_tpu.serve``."""
+
+    def __init__(self, port: Optional[int] = None,
+                 workers: Optional[int] = None,
+                 queue_cap: Optional[int] = None,
+                 state_dir: Optional[str] = None,
+                 comm=None, paused: Optional[bool] = None,
+                 budgets: Optional[TenantBudgets] = None):
+        self.port = port if port is not None \
+            else env_knob("MRTPU_SERVE_PORT", int, 0)
+        self.nworkers = workers if workers is not None \
+            else env_knob("MRTPU_SERVE_WORKERS", int, 2)
+        cap = queue_cap if queue_cap is not None \
+            else env_knob("MRTPU_SERVE_QUEUE", int, 16)
+        self.state_dir = state_dir or os.environ.get(
+            "MRTPU_SERVE_STATE") or "mrtpu-serve"
+        # paused = admit + journal but do not execute (maintenance /
+        # pre-drain staging; also what makes the kill-mid-queue replay
+        # test deterministic)
+        self.paused = paused if paused is not None \
+            else os.environ.get("MRTPU_SERVE_PAUSED", "") == "1"
+        self.comm = comm
+        self.queue = AdmissionQueue(cap)
+        self.budgets = budgets or TenantBudgets()
+        self.sessions: Dict[str, Session] = {}
+        self._order: List[str] = []        # admission order, for /v1/jobs
+        self._lock = threading.Lock()
+        self._submit_lock = threading.Lock()
+        self._seq = 0
+        self._draining = False
+        self._stopped = threading.Event()
+        self._workers: List[threading.Thread] = []
+        self._active = 0
+        self._ewma_wall = 1.0              # Retry-After estimator
+        self._journal = None
+        self._owns_httpd = False
+
+    # -- paths -------------------------------------------------------------
+    def session_dir(self, sid: str) -> str:
+        return os.path.join(self.state_dir, "sessions", sid)
+
+    def result_path(self, sid: str) -> str:
+        return os.path.join(self.state_dir, "results", sid + ".json")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        """Recover + serve; returns the bound port."""
+        global _CURRENT
+        from ..ft.journal import Journal
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._journal = Journal(self.state_dir, script_mode=True)
+        self._recover()
+        from ..obs import httpd, metrics
+        reg = metrics.enable_metrics()
+        reg.register_collector(_collect_serve)
+        _CURRENT = self
+        httpd.register_routes("/v1/", self._handle)
+        prev = httpd.get_server()
+        self._owns_httpd = prev is None or not prev.running
+        self.port = httpd.ensure_server(self.port)
+        atomic_write_json(os.path.join(self.state_dir, "serve.json"),
+                          {"port": self.port, "pid": os.getpid(),
+                           "paused": self.paused})
+        self._warm_imports()
+        if not self.paused:
+            for i in range(max(0, self.nworkers)):
+                t = threading.Thread(target=self._worker_loop,
+                                     name=f"mrtpu-serve-w{i}",
+                                     daemon=True)
+                t.start()
+                self._workers.append(t)
+        return self.port
+
+    def _warm_imports(self) -> None:
+        """Import the session execution stack on the main thread BEFORE
+        any worker exists: two workers lazily importing the same module
+        tree can hit CPython's partially-initialized-module window, and
+        a warm daemon should pay import cost at start, not on the first
+        tenant's request."""
+        from ..oink.command import COMMANDS  # noqa: F401
+        from ..oink.script import OinkScript  # noqa: F401
+        from ..ft.journal import read_journal  # noqa: F401
+        from .session import run_session  # noqa: F401
+        from ..plan.cache import cache_stats
+        cache_stats()       # pulls parallel/shuffle (the /v1/stats path)
+
+    def _recover(self) -> None:
+        """Replay the serve journal: accepted-but-unfinished sessions
+        re-enter the queue in admission order (``force=True`` — the
+        journal's accept beats the restart's queue cap); finished ones
+        reload as DONE/FAILED stubs whose results serve from disk."""
+        from ..ft.journal import read_journal
+        try:
+            recs = read_journal(self.state_dir)
+        except MRError:
+            return
+        done: Dict[str, str] = {}
+        submits: List[dict] = []
+        for r in recs:
+            if r.get("kind") == "serve_submit":
+                submits.append(r)
+                self._seq = max(self._seq, int(r.get("seq", 0)))
+            elif r.get("kind") == "serve_done":
+                done[r.get("sid", "")] = r.get("status", DONE)
+        for r in submits:
+            sid = r["sid"]
+            if done.get(sid) == "rejected":
+                # compensated submit (a shutdown race): the client was
+                # told "not accepted" — never replay or list it
+                continue
+            sess = Session(sid=sid, tenant=r.get("tenant", "default"),
+                           payload=r.get("payload", ""),
+                           fmt=r.get("fmt", "oink"),
+                           submitted_utc=r.get("utc", ""))
+            if sid in done:
+                sess.state = done[sid]
+            else:
+                self.queue.offer(sess, force=True)
+            with self._lock:
+                self.sessions[sid] = sess
+                self._order.append(sid)
+
+    def drain(self) -> None:
+        self._draining = True
+
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Drain, finish the queue, stop workers and (if we bound it)
+        the HTTP listener.  Idempotent."""
+        global _CURRENT
+        self.drain()
+        self.queue.close()
+        self._stopped.set()
+        for t in self._workers:
+            t.join(timeout=timeout)
+        self._workers = []
+        from ..obs import httpd
+        httpd.unregister_routes("/v1/")
+        if _CURRENT is self:
+            _CURRENT = None
+        if self._owns_httpd:
+            httpd.stop_server()
+        # the submit lock serializes the close against an in-flight
+        # submit's journal append (an embedded daemon that does not own
+        # the HTTP listener has no handler drain to rely on)
+        with self._submit_lock:
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._stopped.wait(timeout)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, body: dict) -> tuple:
+        """→ (http_code, response_dict, extra_headers_or_None)."""
+        if self._draining:
+            return 503, {"error": "draining: not admitting new work"}, \
+                {"Retry-After": 60}
+        try:
+            payload = normalize_payload(body)
+        except MRError as e:
+            return 400, {"error": str(e)}, None
+        tenant = str(body.get("tenant") or "default")
+        fmt = "ops" if body.get("ops") is not None else "oink"
+        with self._submit_lock:
+            if self._journal is None:       # shutdown closed it
+                return 503, {"error": "shutting down"}, \
+                    {"Retry-After": 60}
+            if self.queue.full():
+                self.queue.reject()
+                self._metric_admission("rejected")
+                return 429, {"error": "admission queue full"}, \
+                    {"Retry-After": self.retry_after()}
+            self._seq += 1
+            sid = f"s{self._seq:06d}"
+            sess = Session(
+                sid=sid, tenant=tenant, payload=payload, fmt=fmt,
+                submitted_utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                            time.gmtime()))
+            # the journal record lands BEFORE the queue sees the
+            # session (and before the client's 202): a crash after
+            # this line replays the session; a crash before it means
+            # the client never heard "accepted" — either way the
+            # journal and the promise agree
+            self._journal.append(
+                {"kind": "serve_submit", "sid": sid, "tenant": tenant,
+                 "fmt": fmt, "payload": payload, "seq": self._seq,
+                 "utc": sess.submitted_utc})
+            if not self.queue.offer(sess, force=True):
+                # capacity is held by the submit lock, so the only way
+                # force-offer fails is a shutdown() that closed the
+                # queue after the drain check above — compensate the
+                # already-journaled submit so a restart never replays
+                # a session whose client heard "not accepted"
+                self._journal.append({"kind": "serve_done", "sid": sid,
+                                      "status": "rejected"})
+                return 503, {"error": "shutting down"}, \
+                    {"Retry-After": 60}
+            with self._lock:
+                self.sessions[sid] = sess
+                self._order.append(sid)
+        self._metric_admission("accepted")
+        return 202, {"id": sid, "state": QUEUED, "tenant": tenant}, None
+
+    def retry_after(self) -> int:
+        """Honest backpressure: the queue's expected drain time under
+        the rolling mean session wall, not a constant."""
+        per = self._ewma_wall / max(1, len(self._workers) or 1)
+        return max(1, int(self.queue.depth() * per + 0.5))
+
+    def _metric_admission(self, outcome: str) -> None:
+        try:
+            from ..obs.metrics import get_registry
+            get_registry().counter(
+                "mrtpu_serve_admission_total",
+                "admission decisions by outcome",
+                ("outcome",)).inc(outcome=outcome)
+        except Exception:
+            pass
+
+    # -- workers -----------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            sess = self.queue.take(timeout=0.25)
+            if sess is None:
+                if self._stopped.is_set() and self.queue.depth() == 0:
+                    return
+                continue
+            with self._lock:
+                self._active += 1
+            try:
+                result = run_session(self, sess)
+            except Exception as e:    # run_session already shields; belt
+                sess.error = f"{type(e).__name__}: {e}"
+                try:
+                    atomic_write_json(
+                        self.result_path(sess.sid),
+                        {"id": sess.sid, "tenant": sess.tenant,
+                         "status": FAILED, "error": sess.error})
+                except Exception:
+                    pass
+                sess.state = FAILED    # after the durable result, like
+                #                        run_session's flip ordering
+            finally:
+                with self._lock:
+                    self._active -= 1
+            self._ewma_wall = 0.7 * self._ewma_wall + \
+                0.3 * float(sess.wall_s or 1.0)
+            # completion record follows the durable result file.  A
+            # worker draining past shutdown's join timeout may find the
+            # journal closed — the missing done record only costs one
+            # redundant (idempotent) replay on the next restart
+            try:
+                self._journal.append({"kind": "serve_done",
+                                      "sid": sess.sid,
+                                      "status": sess.state})
+            except (ValueError, OSError, AttributeError):
+                pass
+            self._metric_session(sess)
+
+    def _metric_session(self, sess: Session) -> None:
+        try:
+            from ..obs.metrics import get_registry
+            reg = get_registry()
+            reg.counter("mrtpu_serve_sessions_total",
+                        "finished sessions by tenant and status",
+                        ("tenant", "status")).inc(
+                            tenant=sess.tenant, status=sess.state)
+            reg.histogram("mrtpu_serve_session_seconds",
+                          "session wall time by tenant and status",
+                          ("tenant", "status")).observe(
+                              float(sess.wall_s or 0.0),
+                              tenant=sess.tenant, status=sess.state)
+        except Exception:
+            pass
+
+    def active_count(self) -> int:
+        with self._lock:
+            return self._active
+
+    # -- reads -------------------------------------------------------------
+    def status(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            sess = self.sessions.get(sid)
+        return sess.summary() if sess else None
+
+    def result(self, sid: str) -> tuple:
+        """→ (code, dict): 200 done/failed, 202 pending, 404 unknown."""
+        with self._lock:
+            sess = self.sessions.get(sid)
+        if sess is None:
+            return 404, {"error": f"no session {sid!r}"}
+        if sess.state in (QUEUED, RUNNING):
+            return 202, sess.summary()
+        import json
+        try:
+            with open(self.result_path(sid)) as f:
+                return 200, json.load(f)
+        except (OSError, ValueError):
+            # done per journal but the result file is missing/torn (a
+            # crash window) — surface the summary rather than a 500
+            return 200, {**sess.summary(),
+                         "error": sess.error or "result file unavailable"}
+
+    def stats(self) -> dict:
+        from ..plan.cache import cache_stats
+        with self._lock:
+            states: Dict[str, int] = {}
+            for s in self.sessions.values():
+                states[s.state] = states.get(s.state, 0) + 1
+            active = self._active
+        return {"queue": self.queue.stats(),
+                "sessions": {"active": active, "by_state": states,
+                             "total": len(self._order)},
+                "tenants": self.budgets.snapshot(),
+                "plan": cache_stats(),
+                "draining": self._draining, "paused": self.paused,
+                "workers": len(self._workers), "port": self.port,
+                "state_dir": self.state_dir}
+
+    # -- HTTP routing (obs/httpd.register_routes handler) ------------------
+    def _handle(self, method: str, path: str, body: bytes,
+                headers: dict) -> tuple:
+        import json
+        parts = [p for p in path.split("/") if p]      # ["v1", ...]
+        if len(parts) < 2 or parts[0] != "v1":
+            return 404, {"error": "not found"}, "application/json", None
+        rest = parts[1:]
+        if method == "POST" and rest == ["jobs"]:
+            try:
+                obj = json.loads(body.decode() or "{}")
+                if not isinstance(obj, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, UnicodeDecodeError) as e:
+                return 400, {"error": f"bad JSON body: {e}"}, \
+                    "application/json", None
+            code, out, extra = self.submit(obj)
+            return code, out, "application/json", extra
+        if method == "GET" and rest == ["jobs"]:
+            with self._lock:
+                out = [self.sessions[sid].summary()
+                       for sid in self._order]
+            return 200, {"jobs": out}, "application/json", None
+        if method == "GET" and len(rest) == 2 and rest[0] == "jobs":
+            st = self.status(rest[1])
+            if st is None:
+                return 404, {"error": f"no session {rest[1]!r}"}, \
+                    "application/json", None
+            return 200, st, "application/json", None
+        if method == "GET" and len(rest) == 3 and rest[0] == "jobs" \
+                and rest[2] == "result":
+            code, out = self.result(rest[1])
+            return code, out, "application/json", None
+        if method == "GET" and rest == ["stats"]:
+            return 200, self.stats(), "application/json", None
+        if method == "POST" and rest == ["drain"]:
+            self.drain()
+            return 200, {"draining": True}, "application/json", None
+        if method == "POST" and rest == ["shutdown"]:
+            # respond first, stop after: the stop path drains in-flight
+            # HTTP handlers, and THIS handler is one of them
+            threading.Thread(target=self._deferred_shutdown,
+                             daemon=True).start()
+            return 200, {"shutting_down": True}, "application/json", None
+        return 404, {"error": "not found"}, "application/json", None
+
+    def _deferred_shutdown(self) -> None:
+        time.sleep(0.2)          # let the 200 flush to the client
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,276 @@
+"""One accepted request = one session.
+
+A session owns: its own OINK namespace (a caller-owned ObjectManager —
+two tenants both running ``mr x`` never collide), a private directory
+under ``<state>/sessions/<sid>/`` holding its output files (``out/``),
+its spill scratch (``spill/``), and its ft/ journal + auto-checkpoints
+(``journal.jsonl``, ``ckpt-*``), and a tenant page account installed as
+a thread scope for the whole run.
+
+Crash recovery: a session that was RUNNING when the daemon died left a
+journal with a ``begin`` record (and usually a checkpoint) in its
+directory; :func:`run_session` detects that on the replayed attempt and
+drives ``ft.resume_into`` instead of a fresh ``run_string`` — the
+recorded command prefix is skipped, the MRs restore from the last
+durable checkpoint, and the remaining commands re-execute, reproducing
+the session's output FILES byte-identically (screen output of already-
+checkpointed commands is not replayed — doc/serve.md#recovery).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..core.runtime import MRError, global_counters, page_account_scope
+
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+# result files stay fetchable but must not become a covert bulk store:
+# bigger payloads ship as sha256 + size only
+_INLINE_FILE_CAP = 256 * 1024
+# same discipline for captured screen output: one print-happy tenant
+# must not grow the daemon's heap (or the fsync'd result file) without
+# bound — the tail past the cap is dropped with a marker
+_OUTPUT_CAP = 4 * _INLINE_FILE_CAP
+
+
+class _CappedScreen:
+    """A write-only text sink that keeps the first ``cap`` characters
+    and counts the rest (bounds both worker heap and result size)."""
+
+    def __init__(self, cap: int = _OUTPUT_CAP):
+        self.cap = cap
+        self._parts: list = []
+        self._len = 0
+        self.dropped = 0
+
+    def write(self, s: str) -> int:
+        room = self.cap - self._len
+        if room > 0:
+            kept = s[:room]
+            self._parts.append(kept)
+            self._len += len(kept)
+            self.dropped += len(s) - len(kept)
+        else:
+            self.dropped += len(s)
+        return len(s)
+
+    def flush(self) -> None:
+        pass
+
+    def getvalue(self) -> str:
+        text = "".join(self._parts)
+        if self.dropped:
+            text += f"\n...[output truncated: {self.dropped} more " \
+                    f"characters dropped past the {self.cap} cap]\n"
+        return text
+
+
+@dataclass
+class Session:
+    sid: str
+    tenant: str
+    payload: str                  # the OINK script text (ops batches
+    #                               normalize to one at submit time)
+    fmt: str = "oink"
+    state: str = QUEUED
+    submitted_utc: str = ""
+    error: Optional[str] = None
+    wall_s: Optional[float] = None
+    resumed: bool = False
+
+    def summary(self) -> dict:
+        return {"id": self.sid, "tenant": self.tenant,
+                "state": self.state,
+                "submitted_utc": self.submitted_utc,
+                "wall_s": self.wall_s, "error": self.error,
+                "resumed": self.resumed}
+
+
+def normalize_payload(body: dict) -> str:
+    """Accept either an OINK script (``{"script": "..."}``) or a JSON
+    batch of MR op lines (``{"ops": ["mr x", "x map/file ...", ...]}``)
+    and return the script text both execute as."""
+    script = body.get("script")
+    ops = body.get("ops")
+    if isinstance(script, str) and script.strip():
+        if ops is not None:
+            raise MRError("submit takes script OR ops, not both")
+        return script
+    if isinstance(ops, list) and ops and \
+            all(isinstance(o, str) for o in ops):
+        return "\n".join(ops) + "\n"
+    raise MRError("submit body needs a non-empty 'script' string or "
+                  "'ops' list of command strings")
+
+
+def _resumable(sdir: str) -> bool:
+    from ..ft.journal import read_journal
+    try:
+        return any(r.get("kind") == "begin" for r in read_journal(sdir))
+    except MRError:
+        return False
+
+
+def _collect_files(outdir: str) -> dict:
+    out = {}
+    for root, _dirs, files in os.walk(outdir):
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, outdir)
+            try:
+                # stream the hash: a multi-GB -o dump must not spike
+                # the worker's heap by its own size
+                h = hashlib.sha256()
+                nbytes = 0
+                head = b""
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        if nbytes <= _INLINE_FILE_CAP:
+                            head += chunk
+                        h.update(chunk)
+                        nbytes += len(chunk)
+            except OSError:
+                continue
+            rec = {"sha256": h.hexdigest(), "bytes": nbytes}
+            if nbytes <= _INLINE_FILE_CAP:
+                try:
+                    rec["text"] = head.decode()
+                except UnicodeDecodeError:
+                    pass
+            out[rel] = rec
+    return out
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    """tmp + fsync + rename: a crash mid-write leaves only ``*.tmp``,
+    never a torn result a restarted daemon would serve."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def run_session(server, sess: Session) -> dict:
+    """Execute one session on a worker thread; returns (and durably
+    writes) the result record.  Never raises — a failing script is a
+    FAILED session, not a dead worker."""
+    from ..ft.journal import Journal, resume_into
+    from ..oink.objects import ObjectManager
+    from ..oink.script import OinkScript
+    from ..plan.cache import cache_stats, stats_delta
+
+    sdir = server.session_dir(sess.sid)
+    outdir = os.path.join(sdir, "out")
+    spill = os.path.join(sdir, "spill")
+    os.makedirs(outdir, exist_ok=True)
+    os.makedirs(spill, exist_ok=True)
+
+    screen = _CappedScreen()
+    om = ObjectManager(comm=server.comm)
+    defaults = server.budgets.defaults_for(sess.tenant, spill)
+    if server.budgets.pages > 0:
+        # an armed tenant budget is PINNED: the script's own `set`
+        # cannot lift maxpage/memsize/outofcore (or redirect fpath out
+        # of the session scratch) past the allowance
+        om.pin(**defaults)
+    else:
+        for k, v in defaults.items():
+            om.set_default(k, v)
+    script = OinkScript(screen=screen, obj=om)
+    script._path_prepend = outdir    # -o files land in the session dir
+    script._path_root = outdir       # `set prepend` re-roots UNDER it
+    if script._ft_journal is not None:
+        # MRTPU_JOURNAL in the daemon's environment armed a script
+        # journal pointing somewhere global — sessions journal into
+        # their OWN directory, always.  Deactivate it BEFORE closing:
+        # from_env installed it as the process-global op sink, and a
+        # barrier op writing to the closed handle would fail the
+        # session (ft/journal.note_op reads the active journal)
+        from ..ft.journal import activate, active
+        env_j = script._ft_journal
+        script._ft_journal = None
+        if active() is env_j:
+            activate(None)
+        env_j.close()
+
+    acct = server.budgets.account(sess.tenant)
+    sess.state = RUNNING
+    sess.resumed = _resumable(sdir)
+    cache_before = cache_stats()
+    nd0 = global_counters().snapshot()["ndispatch"]
+    t0 = time.perf_counter()
+    error: Optional[str] = None
+    try:
+        with page_account_scope(acct):
+            if sess.resumed:
+                resume_into(script, sdir)
+            else:
+                script._ft_journal = Journal(sdir, script_mode=True)
+                try:
+                    script.run_string(sess.payload)
+                finally:
+                    if script._ft_journal is not None:
+                        script._ft_journal.close()
+            cur = script.obj      # a script-level `clear` REPLACES the
+            #                       manager; report/clean the live one
+            mrs = {name: (cur.named[name].kv.nkv
+                          if cur.named[name].kv is not None else None)
+                   for name in sorted(cur.named)}
+    except Exception as e:       # noqa: BLE001 — session isolation
+        error = f"{type(e).__name__}: {e}"
+        mrs = {}
+    finally:
+        # sessions are one-shot: release every frame the namespace
+        # still holds (inside the account scope callers of free() run
+        # on this thread, so the tenant gauge deflates too)
+        with page_account_scope(acct):
+            try:
+                cur = script.obj
+                cur.cleanup()
+                for name in list(cur.named):
+                    cur.delete_mr(name)
+            except Exception:
+                pass
+    wall = time.perf_counter() - t0
+
+    sess.wall_s = round(wall, 4)
+    sess.error = error
+    status = FAILED if error else DONE
+    result = {
+        "id": sess.sid, "tenant": sess.tenant, "status": status,
+        "error": error,
+        "output": screen.getvalue(),
+        "files": _collect_files(outdir),
+        "mrs": mrs,
+        # the deltas are over PROCESS-global counters/caches: exact when
+        # this was the only session executing in the window (1 worker,
+        # or an idle daemon — how bench --serve and the acceptance test
+        # read them); with concurrent sessions they include the
+        # neighbors' traffic (doc/serve.md)
+        "meta": {
+            "wall_s": sess.wall_s,
+            "resumed": sess.resumed,
+            "dispatches": global_counters().snapshot()["ndispatch"] - nd0,
+            "plan_cache": stats_delta(cache_before),
+            "pages": acct.snapshot(),
+        },
+    }
+    # the durable result lands BEFORE the state flips: a client polling
+    # at 50 ms must never observe state=done while the result file is
+    # still unwritten (it would read a bogus "result file unavailable"
+    # final record)
+    atomic_write_json(server.result_path(sess.sid), result)
+    sess.state = status
+    return result

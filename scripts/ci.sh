@@ -1,13 +1,16 @@
 #!/usr/bin/env bash
 # CI gate: the ROADMAP tier-1 suite plus fast subsets (fused-plan
 # equivalence, metrics/flight-recorder, exec overlap/donation golden
-# equivalence, ft chaos-golden/resume) so a regression there fails
-# loudly even when only the quick gate runs, and an ADVISORY bench
-# regression check (scripts/bench_compare.py) that prints its verdict
-# table into the CI log but never fails the build.
+# equivalence, ft chaos-golden/resume, serve API/admission) so a
+# regression there fails loudly even when only the quick gate runs,
+# and an ADVISORY bench regression check (scripts/bench_compare.py)
+# that prints its verdict table into the CI log but never fails the
+# build.
 #
-#   scripts/ci.sh          # tier-1 + plan/metrics/exec/ft subsets + advisory
-#   scripts/ci.sh quick    # plan + metrics + exec + ft subsets only (~1 min)
+#   scripts/ci.sh          # tier-1 + plan/metrics/exec/ft subsets
+#                          # + full serve subset (kill-9 queue replay)
+#                          # + advisory
+#   scripts/ci.sh quick    # plan/metrics/exec/ft/serve fast subsets (~1 min)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -35,6 +38,19 @@ run_ft_subset() {
       -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_serve_subset_quick() {
+  echo "== serve API round-trip + admission subset (fast) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+      -k 'roundtrip or admission or drain or queue_bounds or plan_cache' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_serve_subset_full() {
+  echo "== serve full subset (incl. kill-9 queue replay) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 bench_compare_advisory() {
   # advisory only: the verdict table lands in the CI log; a regression
   # (or a compare bug) must not fail the build — bench.py --gate is the
@@ -48,6 +64,7 @@ if [ "${1:-}" = "quick" ]; then
   run_metrics_subset
   run_exec_subset
   run_ft_subset
+  run_serve_subset_quick
   bench_compare_advisory
   exit 0
 fi
@@ -66,4 +83,5 @@ run_plan_subset
 run_metrics_subset
 run_exec_subset
 run_ft_subset
+run_serve_subset_full
 bench_compare_advisory

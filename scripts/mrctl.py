@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""mrctl — operator client for the serve/ daemon (doc/serve.md).
+
+    mrctl.py [--port N | --state DIR] submit FILE [--tenant T] [--wait]
+    mrctl.py [...] submit - --tenant T          # script from stdin
+    mrctl.py [...] status [SID]                 # one session / all
+    mrctl.py [...] result SID [--wait SECS]
+    mrctl.py [...] stats
+    mrctl.py [...] drain
+    mrctl.py [...] shutdown
+
+Daemon discovery: ``--port`` wins; otherwise ``--state DIR`` (or
+``MRTPU_SERVE_STATE``) reads the bound port from ``DIR/serve.json`` —
+which is how an ephemeral-port (``--port 0``) daemon is addressed.
+Exit codes: 0 ok, 2 usage, 3 daemon unreachable, 4 rejected (429/503 —
+stderr carries Retry-After), 5 session failed, 6 still running at the
+--wait deadline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _client(args):
+    from gpu_mapreduce_tpu.serve.client import ServeClient
+    if args.port is not None:
+        return ServeClient.local(args.port)
+    state = args.state or os.environ.get("MRTPU_SERVE_STATE")
+    if not state:
+        print("need --port or --state (or MRTPU_SERVE_STATE)",
+              file=sys.stderr)
+        sys.exit(2)
+    try:
+        return ServeClient.from_state_dir(state)
+    except (OSError, ValueError) as e:
+        print(f"cannot discover daemon from {state!r}: {e}",
+              file=sys.stderr)
+        sys.exit(3)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="mrctl", description=__doc__.split(
+        "\n", 1)[0], formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--state", default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("submit")
+    sp.add_argument("file", help="OINK script path, or - for stdin")
+    sp.add_argument("--tenant", default="default")
+    sp.add_argument("--wait", action="store_true",
+                    help="block until the session finishes; print the "
+                         "result record")
+    sp.add_argument("--timeout", type=float, default=3600.0,
+                    metavar="SECS",
+                    help="--wait poll deadline (default 3600); a "
+                         "session still running at the deadline exits "
+                         "6, not 3")
+    st = sub.add_parser("status")
+    st.add_argument("sid", nargs="?")
+    rs = sub.add_parser("result")
+    rs.add_argument("sid")
+    rs.add_argument("--wait", type=float, default=0.0, metavar="SECS")
+    sub.add_parser("stats")
+    sub.add_parser("drain")
+    sub.add_parser("shutdown")
+    args = p.parse_args(argv)
+
+    from gpu_mapreduce_tpu.serve.client import ServeError
+    c = _client(args)
+    try:
+        if args.cmd == "submit":
+            text = sys.stdin.read() if args.file == "-" else \
+                open(args.file).read()
+            r = c.submit(script=text, tenant=args.tenant)
+            if args.wait:
+                r = c.wait(r["id"], timeout=args.timeout)
+                print(json.dumps(r, indent=2))
+                return 5 if r.get("status") == "failed" else 0
+            print(json.dumps(r))
+        elif args.cmd == "status":
+            out = c.status(args.sid) if args.sid else c.jobs()
+            print(json.dumps(out, indent=2))
+        elif args.cmd == "result":
+            r = c.wait(args.sid, timeout=args.wait) if args.wait \
+                else c.result(args.sid)
+            print(json.dumps(r, indent=2))
+            return 5 if r.get("status") == "failed" else 0
+        elif args.cmd == "stats":
+            print(json.dumps(c.stats(), indent=2))
+        elif args.cmd == "drain":
+            print(json.dumps(c.drain()))
+        elif args.cmd == "shutdown":
+            print(json.dumps(c.shutdown()))
+        return 0
+    except ServeError as e:
+        print(f"{e}", file=sys.stderr)
+        if e.retry_after is not None:
+            print(f"Retry-After: {e.retry_after}s", file=sys.stderr)
+        if e.code in (429, 503):
+            return 4
+        return 6 if e.code == 408 else 3    # 408 = still running at
+        #                                     the --wait deadline
+    except OSError as e:
+        print(f"daemon unreachable: {e}", file=sys.stderr)
+        return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

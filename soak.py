@@ -485,14 +485,101 @@ def main():
             finally:
                 ft.reset()
 
+    def do_serve():
+        # MR-as-a-service row (serve/): N concurrent clients hammer an
+        # in-process daemon with the same wordfreq workload — requests
+        # amortize the plan cache across tenants, 429s are retried
+        # after the daemon's own Retry-After (honest backpressure), and
+        # the published numbers are sustained requests/sec + tail
+        # latency (doc/serve.md)
+        import tempfile
+        import threading
+
+        from gpu_mapreduce_tpu.serve import Server, ServeClient, ServeError
+        nclients = env_knob("SOAK_SERVE_CLIENTS", int, 4)
+        nreqs = env_knob("SOAK_SERVE_REQS", int, 8)
+        with tempfile.TemporaryDirectory() as tmp:
+            corpus = os.path.join(tmp, "corpus.txt")
+            rng4 = np.random.default_rng(23)
+            with open(corpus, "w") as f:
+                for w in rng4.integers(0, 2048, 60000):
+                    f.write(f"w{w:04d} ")
+            script = (f"variable files index {corpus}\n"
+                      f"set fuse 1\n"
+                      f"wordfreq 5 -i v_files\n")
+            srv = Server(port=0, workers=min(4, max(1, nclients)),
+                         queue_cap=max(8, nclients * 2),
+                         state_dir=os.path.join(tmp, "state"))
+            port = srv.start()
+            lat: list = []
+            nrejects = [0]
+            client_errors: list = []
+            lock = threading.Lock()
+
+            def one_client(ci: int):
+                try:
+                    c = ServeClient.local(port)
+                    done = 0
+                    while done < nreqs:
+                        t0 = time.perf_counter()
+                        try:
+                            r = c.submit(script=script, tenant=f"c{ci}")
+                        except ServeError as e:
+                            if e.code != 429:
+                                raise
+                            with lock:
+                                nrejects[0] += 1
+                            time.sleep(min(2.0, e.retry_after or 1))
+                            continue
+                        res = c.wait(r["id"], timeout=300)
+                        if res.get("status") != "done":
+                            raise RuntimeError(res.get("error"))
+                        with lock:
+                            lat.append(time.perf_counter() - t0)
+                        done += 1
+                except Exception as e:   # noqa: BLE001 — re-raised below
+                    with lock:
+                        client_errors.append(f"client {ci}: {e!r}")
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=one_client, args=(ci,))
+                       for ci in range(nclients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            srv.shutdown()
+            if client_errors:
+                # a dead client thread must fail the workload, not
+                # silently inflate req/s computed from the full total
+                raise RuntimeError("; ".join(client_errors[:3]))
+            total = nclients * nreqs
+            published["serve_requests_per_sec"] = round(total / wall, 2)
+            published["serve_p50_latency_s"] = round(
+                float(np.percentile(lat, 50)), 4)
+            published["serve_p99_latency_s"] = round(
+                float(np.percentile(lat, 99)), 4)
+            published["serve_admission_rejects"] = nrejects[0]
+            print(f"serve: {nclients} clients x {nreqs} reqs in "
+                  f"{wall:.2f}s -> {total / wall:,.1f} req/s, p50 "
+                  f"{np.percentile(lat, 50):.3f}s, p99 "
+                  f"{np.percentile(lat, 99):.3f}s, "
+                  f"{nrejects[0]} 429s retried")
+
     workloads = [("degree", do_degree), ("cc_find", do_cc),
                  ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),
                  ("external", do_external),
                  ("ingest", do_ingest_overlap),
                  ("pagerank", do_pagerank),
-                 ("pagerank_northstar", do_pagerank_northstar)]
+                 ("pagerank_northstar", do_pagerank_northstar),
+                 ("serve", do_serve)]
     if chaos_seed is not None:
         workloads.append(("chaos", do_chaos))
+    serve_only = "serve" in sys.argv[1:]
+    if serve_only:
+        # `soak.py serve`: hammer ONLY the daemon (doc/serve.md)
+        workloads = [("serve", do_serve)]
     for i, (name, fn) in enumerate(workloads, 1):
         guard(name, fn)
         if metrics_every and i % metrics_every == 0:
@@ -530,7 +617,9 @@ def main():
         print("SOAK_DRY=1: not publishing", json.dumps(published))
         return
     key = f"soak_{backend}" if nmesh == 1 else f"soak_{backend}_p{nmesh}"
-    if errors:
+    if errors or serve_only:
+        # partial runs (a failed workload, or the serve-only mode)
+        # merge over the previous record instead of erasing its rows
         for k, v in read_published(key).items():
             published.setdefault(k, v)
     publish(key, published)
