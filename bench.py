@@ -311,6 +311,8 @@ def _knobs():
 FUSE_MODE = None   # --fuse {0,1,ab} (or BENCH_FUSE); None = skip A/B
 OVERLAP_MODE = None  # --overlap {0,1,ab} (or BENCH_OVERLAP); None = skip
 SERVE_MODE = False   # --serve (or BENCH_SERVE=1): daemon cold/warm A/B
+ELASTIC_MODE = False  # --elastic (or BENCH_ELASTIC=1): reshard wall +
+#                       MRTPU_VERIFY read-overhead advisory rows
 GATE = False       # --gate: after the run, regress-check against the
 #                    BENCH_r*.json trailing baseline (scripts/
 #                    bench_compare.py) and exit nonzero on a trip
@@ -529,6 +531,77 @@ def serve_ab_record() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+_ELASTIC_PROBE = r"""
+import json, os, sys, time, tempfile
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+out = {}
+# reshard wall: a ~2M-row aggregated KV across 4->2->8 (host-device mesh)
+mr = MapReduce(make_mesh(4))
+keys = (np.arange(1 << 21, dtype=np.uint64) * 2654435761) % (1 << 20)
+mr.map(1, lambda i, kv, p: kv.add_batch(keys, keys))
+mr.aggregate()
+for w in (2, 8, 4):
+    t0 = time.perf_counter()
+    mr.reshard(make_mesh(w))
+    out[f"reshard_to_{w}_s"] = round(time.perf_counter() - t0, 4)
+out["reshard_rows"] = int(1 << 21)
+# verify-on-read overhead: spill-heavy sort + checkpoint save/reload,
+# MRTPU_VERIFY off vs on (stamping is always on; the knob gates reads)
+tmp = tempfile.mkdtemp(prefix="bench_elastic_")
+skeys = (np.arange(400_000, dtype=np.uint64) * 7919) % (1 << 40)
+def cycle(tag):
+    m = MapReduce(outofcore=1, memsize=1, maxpage=1,
+                  fpath=os.path.join(tmp, "sp" + tag))
+    m.map(1, lambda i, kv, p: kv.add_batch(skeys, skeys))
+    m.sort_keys(1)
+    ck = os.path.join(tmp, "ck" + tag)
+    m.save(ck)
+    MapReduce().load(ck)
+os.environ["MRTPU_VERIFY"] = "0"
+cycle("warm")                              # warm shapes + page cache
+best = {"0": float("inf"), "1": float("inf")}
+for rep in range(2):                       # alternate: ordering noise
+    for flag in ("0", "1"):                # must not masquerade as the
+        os.environ["MRTPU_VERIFY"] = flag  # knob's cost
+        t0 = time.perf_counter()
+        cycle(f"{flag}.{rep}")
+        best[flag] = min(best[flag], time.perf_counter() - t0)
+out["verify_off_s"] = round(best["0"], 4)
+out["verify_on_s"] = round(best["1"], 4)
+off, on = out["verify_off_s"], out["verify_on_s"]
+out["verify_overhead_pct"] = round((on - off) / off * 100.0, 2) if off else 0.0
+print(json.dumps(out))
+"""
+
+
+def elastic_record() -> dict:
+    """``--elastic``: reshard wall times (4→2→8→4 on a CPU host-device
+    mesh) and the MRTPU_VERIFY read-side overhead on a spill-heavy
+    sort + checkpoint cycle — recorded into ``detail.elastic`` as
+    advisory bench_compare rows.  Runs in a subprocess so the fake
+    8-device CPU topology and the MRTPU_VERIFY toggling never leak
+    into the headline measurement's process."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    p = subprocess.run([sys.executable, "-c", _ELASTIC_PROBE],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=os.path.dirname(
+                           os.path.abspath(__file__)))
+    if p.returncode != 0:
+        raise RuntimeError(f"elastic probe failed: {p.stderr[-400:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 def run_bench(engine, backend_err):
     total_mb = int(os.environ.get("BENCH_MB", "256"))
     skew = os.environ.get("BENCH_SKEW", "0") == "1"
@@ -636,6 +709,14 @@ def run_bench(engine, backend_err):
         except Exception:
             detail["serve_ab"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if ELASTIC_MODE:
+        # --elastic: reshard wall + verify-on-read overhead (advisory
+        # bench_compare rows); failures must not cost the headline
+        try:
+            detail["elastic"] = elastic_record()
+        except Exception:
+            detail["elastic"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     try:
         print(json.dumps({"detail": detail}), file=sys.stderr)
     except Exception:
@@ -655,7 +736,7 @@ def run_bench(engine, backend_err):
 
 
 def main():
-    global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, GATE
+    global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, ELASTIC_MODE, GATE
     argv = sys.argv[1:]
     GATE = "--gate" in argv or os.environ.get("BENCH_GATE") == "1"
     if "--fuse" in argv:
@@ -675,6 +756,8 @@ def main():
             f"--overlap takes 0, 1 or ab, got {OVERLAP_MODE!r}")
     SERVE_MODE = "--serve" in argv or \
         os.environ.get("BENCH_SERVE") == "1"
+    ELASTIC_MODE = "--elastic" in argv or \
+        os.environ.get("BENCH_ELASTIC") == "1"
     backend_err = None
     try:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))

@@ -28,7 +28,38 @@ from .frame import KMVFrame, KVFrame
 from .runtime import MRError
 
 _MANIFEST = "manifest.json"
-_VERSION = 1
+_VERSION = 2       # v2: shard manifests + integrity stamps (v1 loads)
+
+
+def _frame_shard_meta(fr) -> dict:
+    """Topology record of one pre-``to_host`` frame: the writer's
+    per-shard row counts (ShardedKV ``counts`` / ShardedKMV
+    ``gcounts``), or None for host frames.  This is what makes a
+    checkpoint *topology-portable*: a restore onto any mesh width knows
+    the global row order (shard-major) without the writer's mesh."""
+    counts = getattr(fr, "gcounts", None)
+    if counts is None:
+        counts = getattr(fr, "counts", None)
+    if counts is None:
+        return {"shards": None, "nprocs": 1}
+    return {"shards": [int(c) for c in counts],
+            "nprocs": int(getattr(fr, "nprocs", len(counts)))}
+
+
+def _shard_digests(payload: dict, shards) -> list:
+    """Per-shard digests of a KV frame's compacted row bytes: shard s
+    owns host rows [cum[s], cum[s+1]) of the shard-major order — the
+    integrity unit a cross-mesh restore can still be audited by."""
+    from ..utils.integrity import array_digest
+    k = payload.get("k_arr")
+    v = payload.get("v_arr")
+    if k is None or v is None or shards is None:
+        return []
+    out, start = [], 0
+    for c in shards:
+        out.append(array_digest(k[start:start + c], v[start:start + c]))
+        start += c
+    return out
 
 
 def save(mr, path: str) -> int:
@@ -53,8 +84,12 @@ def save(mr, path: str) -> int:
                                            else "none")
     nframes = 0
     counts = []
+    frames_meta = []
+    row_start = 0
+    nprocs_max = 1
     try:
         if kind != "none":
+            from ..utils.integrity import file_digest
             ds = mr.kv if kind == "kv" else mr.kmv
             if kind == "kv" and (ds._buf_k or ds._batches):
                 # an MR in the open() cross-add state has pairs only in
@@ -62,6 +97,8 @@ def save(mr, path: str) -> int:
                 raise MRError("cannot checkpoint an MR with uncompleted "
                               "adds; close()/complete it first")
             for fr in ds.frames():
+                smeta = _frame_shard_meta(fr)
+                nprocs_max = max(nprocs_max, smeta["nprocs"])
                 fr = fr.to_host()
                 payload: dict = {}
                 if isinstance(fr, KVFrame):
@@ -75,13 +112,31 @@ def save(mr, path: str) -> int:
                 else:  # pragma: no cover - defensive
                     raise MRError(f"cannot checkpoint frame type "
                                   f"{type(fr).__name__}")
-                np.savez(os.path.join(tmp, f"frame-{nframes:05d}.npz"),
-                         **payload)
+                fname = f"frame-{nframes:05d}.npz"
+                np.savez(os.path.join(tmp, fname), **payload)
                 counts.append(len(fr))
+                # the shard manifest entry: file digest (np.savez seeks,
+                # so stamp by read-back), GLOBAL row range, the writer's
+                # per-shard partition and per-shard row digests — enough
+                # to restore onto any mesh width and audit each piece
+                frames_meta.append({
+                    "file": fname, "n": len(fr),
+                    "rows": [row_start, row_start + len(fr)],
+                    "digest": file_digest(os.path.join(tmp, fname)),
+                    "shards": smeta["shards"],
+                    # per-shard row digests are KV-only: a KMV frame's
+                    # value rows don't align 1:1 with its group counts
+                    "shard_digests": (_shard_digests(payload,
+                                                     smeta["shards"])
+                                      if isinstance(fr, KVFrame) else []),
+                })
+                row_start += len(fr)
                 nframes += 1
         with open(os.path.join(tmp, _MANIFEST), "w") as f:
             json.dump({"version": _VERSION, "kind": kind,
-                       "nframes": nframes, "counts": counts}, f)
+                       "nframes": nframes, "counts": counts,
+                       "frames": frames_meta,
+                       "mesh": {"nprocs": nprocs_max}}, f)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -133,17 +188,58 @@ def save(mr, path: str) -> int:
     return nframes
 
 
-def load(mr, path: str) -> int:
-    """Replace mr's dataset with the checkpoint at ``path``; returns the
-    global pair/group count (like every mutating op)."""
+def read_manifest(path: str) -> dict:
+    """The checkpoint's manifest dict (v1 or v2), or MRError."""
     try:
         with open(os.path.join(path, _MANIFEST)) as f:
             man = json.load(f)
     except FileNotFoundError:
         raise MRError(f"no checkpoint manifest under {path!r}")
-    if man.get("version") != _VERSION:
+    if man.get("version") not in (1, _VERSION):
         raise MRError(f"unsupported checkpoint version {man.get('version')}")
+    return man
+
+
+def validate(path: str) -> bool:
+    """Cheap pre-restore probe: manifest readable, every frame file
+    present, and (under MRTPU_VERIFY) every frame digest intact.  THE
+    check ``ft.resume`` runs per checkpoint generation before deciding
+    which one to restore from — a generation with a missing or
+    bit-flipped frame is rejected BEFORE any replay commits to its
+    sequence number, and the previous kept generation takes over."""
+    from ..utils.integrity import (record_integrity_failure,
+                                   verify_enabled, file_digest)
+    try:
+        man = read_manifest(path)
+    except MRError:
+        return False
+    frames = man.get("frames") or [
+        {"file": f"frame-{i:05d}.npz", "digest": None}
+        for i in range(man.get("nframes", 0))]
+    for fm in frames:
+        fpath = os.path.join(path, fm["file"])
+        if not os.path.exists(fpath):
+            return False
+        exp = fm.get("digest")
+        if exp is not None and verify_enabled():
+            if file_digest(fpath) != exp:
+                record_integrity_failure("checkpoint")
+                return False
+    return True
+
+
+def load(mr, path: str) -> int:
+    """Replace mr's dataset with the checkpoint at ``path``; returns the
+    global pair/group count (like every mutating op).  Under
+    ``MRTPU_VERIFY`` (default on) every frame file is checksummed
+    against its manifest stamp before any of its rows are pushed — a
+    bit-flipped checkpoint raises IntegrityError instead of silently
+    restoring garbage (callers with older generations fall back:
+    ``ft.resume``)."""
+    man = read_manifest(path)
     kind = man["kind"]
+    frames_meta = man.get("frames") or []
+    from ..utils.integrity import verify_file
     if mr.kv is not None:
         mr.kv.free()
         mr.kv = None
@@ -161,9 +257,35 @@ def load(mr, path: str) -> int:
         ds = mr._new_kv()
     else:
         ds = mr._new_kmv()
+    from ..utils.integrity import (IntegrityError, array_digest,
+                                   record_integrity_failure,
+                                   verify_enabled)
     for i in range(man["nframes"]):
-        with np.load(os.path.join(path, f"frame-{i:05d}.npz"),
-                     allow_pickle=False) as z:
+        fpath = os.path.join(path, f"frame-{i:05d}.npz")
+        fm = frames_meta[i] if i < len(frames_meta) else {}
+        if fm:
+            # verify-before-consume: the stamp check precedes np.load,
+            # so a corrupt frame can never partially restore
+            verify_file(fpath, fm.get("digest"), "checkpoint")
+        with np.load(fpath, allow_pickle=False) as z:
+            # per-shard row digests: the finer-grained audit of the
+            # same frame — which WRITER shard a mismatch came from
+            # survives the cross-mesh restore (the file digest above
+            # already gates; this localizes)
+            if (verify_enabled() and kind == "kv" and fm.get("shards")
+                    and fm.get("shard_digests") and "k_arr" in z
+                    and "v_arr" in z):
+                k, v, start = z["k_arr"], z["v_arr"], 0
+                for s, (c, exp) in enumerate(zip(fm["shards"],
+                                                 fm["shard_digests"])):
+                    got = array_digest(k[start:start + c],
+                                       v[start:start + c])
+                    if got != exp:
+                        record_integrity_failure("checkpoint")
+                        raise IntegrityError(
+                            "checkpoint",
+                            f"{fpath} (writer shard {s})", exp, got)
+                    start += c
             if kind == "kv":
                 ds._push_frame(KVFrame(_col_from_npz(z, "k"),
                                        _col_from_npz(z, "v")))

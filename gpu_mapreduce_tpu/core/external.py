@@ -88,6 +88,12 @@ class _Run:
         self.buf: Optional[KVFrame] = None
         self.sur: Optional[np.ndarray] = None
         self.pending = None  # exec.spill.Pending when written in background
+        # integrity (utils/integrity.py): the writer's crc stamps of the
+        # exact file bytes, checked once before the first block is
+        # consumed — a bit-flipped run can never be silently merged
+        self.kdigest: Optional[str] = None
+        self.vdigest: Optional[str] = None
+        self._verified = False
 
     def wait_ready(self):
         """Durability barrier: block until this run is fully on disk
@@ -116,10 +122,27 @@ class _Run:
             return ObjectColumn(arr)
         return BytesColumn(arr)
 
+    def verify(self) -> None:
+        """Checksum the run files against the writer's stamps (once,
+        before the first block read; MRTPU_VERIFY=0 skips).  Runs under
+        the caller's ``spill.read`` retry budget: a transient mismatch
+        (torn page cache) recovers on re-read, a persistent one
+        exhausts the budget into a loud MRError — "a bad spill run
+        retries from its writer barrier record"."""
+        if self._verified:
+            return
+        from ..utils.integrity import verify_file
+        verify_file(self.kpath, self.kdigest, "spill")
+        verify_file(self.vpath, self.vdigest, "spill")
+        self._verified = True
+
     def refill(self, block_rows: int, by: str):
         if self.buf is not None or self.pos >= self.n:
             return
         self.wait_ready()
+        if not self._verified:
+            from ..ft.retry import retry_call
+            retry_call("spill.read", self.verify, detail=self.kpath)
         stop = min(self.pos + block_rows, self.n)
         # ft/: a torn/transient block read retries under the spill.read
         # budget — loads are idempotent (the run file is immutable once
@@ -180,17 +203,16 @@ def _col_kind(col: Column) -> str:
     return "dense"
 
 
-def _save_col(col: Column, path: str):
+def _save_col(col: Column, path: str) -> str:
     from ..exec.spill import atomic_save
     if _col_kind(col) == "dense":
-        atomic_save(path, np.asarray(col.to_host().data))
-    else:
-        # element-wise build: np.asarray(list, dtype=object) would turn
-        # uniform-length tuple rows into a 2-D array and corrupt keys
-        arr = np.empty(len(col), dtype=object)
-        for i, x in enumerate(col.data):
-            arr[i] = x
-        atomic_save(path, arr, allow_pickle=True)
+        return atomic_save(path, np.asarray(col.to_host().data))
+    # element-wise build: np.asarray(list, dtype=object) would turn
+    # uniform-length tuple rows into a 2-D array and corrupt keys
+    arr = np.empty(len(col), dtype=object)
+    for i, x in enumerate(col.data):
+        arr[i] = x
+    return atomic_save(path, arr, allow_pickle=True)
 
 
 def _write_run(fr: KVFrame, settings, counters, seq: int,
@@ -216,8 +238,10 @@ def _write_run(fr: KVFrame, settings, counters, seq: int,
 
         def _write_both():
             fault_point("spill.write", path=base)
-            _save_col(key, kpath)
-            _save_col(value, vpath)
+            # the writer's stamps land on the run handle the reader
+            # verifies against — in-process, before any barrier release
+            run.kdigest = _save_col(key, kpath)
+            run.vdigest = _save_col(value, vpath)
         retry_call("spill.write", _write_both, detail=base)
         counters.add(wsize=nbytes)
 

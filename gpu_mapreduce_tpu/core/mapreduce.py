@@ -1250,6 +1250,82 @@ class MapReduce:
         from .checkpoint import load as _load
         return _load(self, path)
 
+    # ------------------------------------------------------------------
+    # elastic topology (ROADMAP item 4: reshard live, resume anywhere)
+    # ------------------------------------------------------------------
+    @_traced
+    def reshard(self, comm) -> int:
+        """Redistribute the resident dataset onto a new topology and
+        swap the backend — the live elasticity op (parallel/reshard.py,
+        doc/reliability.md#elastic-recovery).
+
+        ``comm``: a ``jax.sharding.Mesh`` of any width (sharded frames
+        move N→M as a collective range exchange, global row/group order
+        preserved exactly), or ``None``/an int for the serial backend
+        (sharded frames compact to host).  Host-resident frames are
+        untouched either way — they shard lazily at the next
+        ``aggregate`` under the new backend, like fresh data.  Returns
+        the global pair/group count, like every mutating op."""
+        self._flush_plan()
+        from .runtime import Timer as _T
+        t = _T()
+        if comm is None or isinstance(comm, int):
+            new_backend = SerialBackend()
+            mesh = None
+        else:
+            from ..parallel.backend import MeshBackend
+            new_backend = MeshBackend(comm)
+            mesh = comm
+        from ..parallel.reshard import reshard_kmv, reshard_kv
+        from ..parallel.sharded import ShardedKMV, ShardedKV
+        from ..parallel.shuffle import free_if_donated
+        nfrom = self.backend.nprocs
+
+        def move(ds, fr):
+            if not isinstance(fr, (ShardedKV, ShardedKMV)):
+                return fr
+            if mesh is None:
+                return fr.to_host()
+            if fr.mesh is mesh:
+                return fr
+            try:
+                if isinstance(fr, ShardedKV):
+                    return reshard_kv(fr, mesh,
+                                      transport=self.settings.all2all,
+                                      counters=self.counters)
+                return reshard_kmv(fr, mesh,
+                                   transport=self.settings.all2all,
+                                   counters=self.counters)
+            except BaseException:
+                # donation may have consumed the frame mid-exchange:
+                # leave a clean empty dataset, not deleted buffers
+                free_if_donated(ds, fr)
+                raise
+        n = 0
+        for ds in (self._kv_data, self._kmv_data):
+            if ds is None:
+                continue
+            out = []
+            for fr in ds._frames:
+                new = move(ds, fr)
+                if new is not fr:
+                    self.counters.mem(new.nbytes() - fr.nbytes())
+                out.append(new)
+            ds._frames = out
+        self.backend = new_backend
+        if self._kv_data is not None:
+            self._kv_data.nkv = sum(self._kv_data._frame_n(f)
+                                    for f in self._kv_data._frames)
+            n = self._kv_data.nkv
+        if self._kmv_data is not None:
+            n = self._kmv_data.complete()
+        n = int(self.backend.allreduce_sum(n))
+        self.counters.add(commtime=t.elapsed())
+        self.last_reshard = {"from": nfrom, "to": self.backend.nprocs,
+                             "wall_s": round(t.elapsed(), 6), "n": n}
+        self._op_stats("reshard", nkv=n)
+        return n
+
     def stats(self) -> dict:
         """The structured cumulative snapshot that ``cummulative_stats``
         prints: every Counters field by name (msizemax, rsize, wsize,

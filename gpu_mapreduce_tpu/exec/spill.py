@@ -33,17 +33,23 @@ import numpy as np
 
 
 def atomic_save(path: str, arr: np.ndarray, allow_pickle: bool = False
-                ) -> None:
+                ) -> str:
     """``np.save`` through a tmp sibling + ``os.replace`` so the final
     path only ever holds a complete file.  ``path`` must already carry
     its ``.npy`` suffix (saving through a file handle stops np.save
-    appending one to the tmp name)."""
+    appending one to the tmp name).  Returns the crc stamp of the
+    exact bytes written (utils/integrity.py) — np.save writes strictly
+    sequentially, so the stamp costs no read-back pass; readers verify
+    it before consuming the file (``core/external._Run``)."""
+    from ..utils.integrity import ChecksumWriter
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        np.save(f, arr, allow_pickle=allow_pickle)
+        cw = ChecksumWriter(f)
+        np.save(cw, arr, allow_pickle=allow_pickle)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    return cw.digest()
 
 
 class Pending:

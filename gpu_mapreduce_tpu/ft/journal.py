@@ -50,6 +50,24 @@ _LOCK = threading.Lock()
 _ACTIVE: Optional["Journal"] = None
 
 
+def _rec_crc(body: str) -> str:
+    """crc of a record's serialized payload (its ``"c"`` field)."""
+    import zlib
+    return f"{zlib.crc32(body.encode()) & 0xFFFFFFFF:08x}"
+
+
+def _rec_valid(rec: dict) -> bool:
+    """Verify a parsed record against its own ``"c"`` stamp; records
+    written before the integrity layer (no ``"c"``) pass — absence is
+    back-compat, mismatch is corruption."""
+    c = rec.get("c")
+    if c is None:
+        return True
+    body = json.dumps({k: v for k, v in rec.items() if k != "c"},
+                      default=str)
+    return _rec_crc(body) == c
+
+
 class Journal:
     """One append-only journal + its checkpoint directory."""
 
@@ -89,8 +107,15 @@ class Journal:
         whole design rests on records never leading their facts).
         ``sync=False`` skips the fsync — for FORENSIC records nothing
         replays from (op records), so an iterative workload doesn't
-        serialize on one disk flush per barrier op."""
-        line = json.dumps(rec, default=str)
+        serialize on one disk flush per barrier op.
+
+        Every record carries a ``"c"`` crc of its own serialized
+        payload (utils/integrity.py): a bit-flipped or half-torn line
+        is QUARANTINED by :func:`read_journal` instead of replayed —
+        the journal never claims work a corrupt record describes."""
+        body = json.dumps(rec, default=str)
+        line = json.dumps({**json.loads(body), "c": _rec_crc(body)},
+                          default=str)
         with self._wlock:
             self._f.write(line + "\n")
             self._f.flush()
@@ -141,6 +166,7 @@ class Journal:
         reldir = f"ckpt-{seq:05d}"
         cdir = os.path.join(self.dir, reldir)
         mrs: Dict[str, dict] = {}
+        nprocs = 1
         try:
             for name in sorted(obj.named):
                 mr = obj.named[name]
@@ -148,6 +174,7 @@ class Journal:
                 retry_call("checkpoint.save",
                            lambda m=mr, p=path: _cksave(m, p),
                            detail=path)
+                nprocs = max(nprocs, int(mr.backend.nprocs))
                 mrs[name] = {"path": f"{reldir}/{name}",
                              "settings": dataclasses.asdict(mr.settings)}
         except Exception:
@@ -158,7 +185,11 @@ class Journal:
             # the run it protects (KeyboardInterrupt/SystemExit pass)
             shutil.rmtree(cdir, ignore_errors=True)
             return False
-        self.append({"kind": "ckpt", "seq": seq, "mrs": mrs})
+        # the writer's mesh width rides the record: a resume onto a
+        # DIFFERENT width restores fine (checkpoints are host frames)
+        # but must surface the fact (serve/'s meta.resharded)
+        self.append({"kind": "ckpt", "seq": seq, "mrs": mrs,
+                     "nprocs": nprocs})
         self.nckpt += 1
         self._since = 0
         self._gc(keep=2)
@@ -179,7 +210,7 @@ class Journal:
         except Exception:
             return      # open()-state MR / disk / injection: next time
         self.append({"kind": "auto_ckpt", "op_seq": self.op_seq,
-                     "path": "auto"})
+                     "path": "auto", "nprocs": int(mr.backend.nprocs)})
         self.nckpt += 1
         self._since = 0
 
@@ -309,7 +340,7 @@ def read_journal(dir: str) -> List[dict]:
                 if not ln:
                     continue
                 try:
-                    out.append(json.loads(ln))
+                    rec = json.loads(ln)
                 except ValueError:
                     # torn line from a crash mid-append.  SKIP, don't
                     # stop: a journal reopened after a kill -9 keeps
@@ -319,15 +350,46 @@ def read_journal(dir: str) -> List[dict]:
                     # was never durable, so treating it as absent is
                     # the records-follow-facts contract
                     continue
+                if isinstance(rec, dict) and not _rec_valid(rec):
+                    # parses as JSON but fails its own crc: a bit flip
+                    # inside the line.  Quarantine it (skip + count) —
+                    # replaying a corrupt record is how a resume turns
+                    # one flipped bit into wrong output
+                    from ..utils.integrity import record_integrity_failure
+                    record_integrity_failure("journal")
+                    continue
+                out.append(rec)
             return out
     except FileNotFoundError:
         raise MRError(f"no journal under {dir!r}")
 
 
+def _ckpt_usable(dir: str, ckpt: dict) -> bool:
+    """Pre-restore probe of one ``ckpt`` record's generation: every
+    named MR's checkpoint directory must validate (manifest + frame
+    files + digests under MRTPU_VERIFY — ``core/checkpoint.validate``).
+    The probe runs BEFORE replay commits to a skip count, which is what
+    lets a damaged newest generation fall back to the previous kept one
+    instead of raising mid-restore."""
+    from ..core.checkpoint import validate
+    try:
+        mrs = ckpt.get("mrs", {})
+        return all(validate(os.path.join(dir, meta["path"]))
+                   for meta in mrs.values())
+    except Exception:
+        return False
+
+
 def plan_resume(dir: str) -> dict:
     """Read the journal and compute the replay plan: the recorded
     script lines, the number of command executions to skip, and the
-    checkpoint record to restore at the skip boundary."""
+    checkpoint record to restore at the skip boundary.
+
+    Generation fallback: the newest ``ckpt`` record whose directories
+    actually VALIDATE wins (missing frame files, a bit-flipped array —
+    keep-2 GC guarantees the previous generation still exists).  A run
+    whose every recorded generation is damaged resumes from scratch
+    (skip 0) — slower, never wrong."""
     recs = read_journal(dir)
     begin_i = max((i for i, r in enumerate(recs)
                    if r.get("kind") == "begin"), default=None)
@@ -336,16 +398,23 @@ def plan_resume(dir: str) -> dict:
                       f"(nothing to resume)")
     begin = recs[begin_i]
     tail = recs[begin_i:]
+    ckpts = [r for r in tail if r.get("kind") == "ckpt"]
+    done = max((int(r.get("seq", 0)) for r in tail
+                if r.get("kind") == "cmd"), default=0)
     ckpt = None
-    done = 0
-    for r in tail:
-        if r.get("kind") == "ckpt":
-            ckpt = r
-        elif r.get("kind") == "cmd":
-            done = max(done, int(r.get("seq", 0)))
+    fell_back = 0
+    for cand in reversed(ckpts):
+        if _ckpt_usable(dir, cand):
+            ckpt = cand
+            break
+        fell_back += 1
+        import sys
+        print(f"ft.resume: checkpoint generation seq={cand.get('seq')} "
+              f"under {dir!r} is damaged or incomplete; falling back",
+              file=sys.stderr)
     return {"lines": begin["lines"], "name": begin.get("name", "<resume>"),
             "skip": int(ckpt["seq"]) if ckpt else 0, "ckpt": ckpt,
-            "cmds_done": done}
+            "cmds_done": done, "generations_skipped": fell_back}
 
 
 def restore_mrs(obj, ckpt: dict, dir: str) -> None:
@@ -365,15 +434,26 @@ def restore_mrs(obj, ckpt: dict, dir: str) -> None:
 def resume_into(script, dir: str) -> None:
     """Drive an (ideally fresh) OinkScript through the resume plan:
     skip the already-checkpointed command executions, restore the MRs,
-    continue live with journaling re-armed into the same directory."""
+    continue live with journaling re-armed into the same directory.
+
+    Topology-portable: the checkpoint's frames are host-side, so the
+    replay restores onto WHATEVER mesh the interpreter carries — a
+    4-shard checkpoint resumes on a 1-, 2- or 8-shard mesh.  When the
+    widths differ, ``script._ft_resharded`` is set so callers (the
+    serve/ daemon's degraded mode) can surface ``meta.resharded``."""
     plan = plan_resume(dir)
     if getattr(script, "_ft_journal", None) is not None:
         script._ft_journal.close()   # replace an env-armed journal
     j = Journal(dir, script_mode=True)
     activate(j)
     j.cmd_seq = plan["skip"]      # seq continues from the restore point
+    ckpt_np = int((plan["ckpt"] or {}).get("nprocs") or 0)
+    here_np = int(script._nprocs()) if hasattr(script, "_nprocs") else 1
+    script._ft_resharded = bool(ckpt_np and ckpt_np != here_np)
     j.append({"kind": "resume", "from_seq": plan["skip"],
               "cmds_done_before_crash": plan["cmds_done"],
+              "nprocs": here_np, "ckpt_nprocs": ckpt_np or None,
+              "generations_skipped": plan.get("generations_skipped", 0),
               "pid": os.getpid()})
     script._ft_journal = j
     script._ft_pending_begin = None   # never shadow the real begin
@@ -396,10 +476,22 @@ def resume_into(script, dir: str) -> None:
         activate(None)
 
 
-def resume(dir: str, comm=None, screen=False, logfile: Optional[str] = None):
+def resume(dir: str, comm=None, screen=False, logfile: Optional[str] = None,
+           mesh=None):
     """``ft.resume(dir)``: build a fresh interpreter and replay the
-    journal's script from its last durable checkpoint.  Returns the
-    finished OinkScript (named MRs inspectable by the caller)."""
+    journal's script from its last durable (and VALID — generation
+    fallback) checkpoint.  Returns the finished OinkScript (named MRs
+    inspectable by the caller).
+
+    ``mesh`` (alias of ``comm``): the target mesh for the replay — it
+    need NOT match the mesh that wrote the checkpoint.  A checkpoint
+    taken on a 4-shard mesh resumes onto 1, 2 or 8 shards; the restored
+    frames are host-side and re-shard on the replay's own collectives
+    (doc/reliability.md#elastic-recovery)."""
+    if mesh is not None:
+        if comm is not None and comm is not mesh:
+            raise MRError("resume: pass comm OR mesh, not both")
+        comm = mesh
     from ..oink.script import OinkScript
     s = OinkScript(comm=comm, screen=screen, logfile=logfile)
     resume_into(s, dir)
@@ -407,14 +499,21 @@ def resume(dir: str, comm=None, screen=False, logfile: Optional[str] = None):
 
 
 def latest_checkpoint(dir: str) -> Optional[str]:
-    """Path of the newest durable checkpoint under a journal dir: the
-    programmatic ``auto`` slot, or the last script ``ckpt`` set's
-    directory.  None when no checkpoint record exists."""
+    """Path of the newest USABLE durable checkpoint under a journal
+    dir: the programmatic ``auto`` slot, or the last script ``ckpt``
+    set's directory that still validates (damaged generations skip to
+    the previous kept one, like resume).  None when no checkpoint
+    record exists."""
+    from ..core.checkpoint import validate
     recs = read_journal(dir)
     for r in reversed(recs):
         if r.get("kind") == "auto_ckpt":
-            return os.path.join(dir, r.get("path", "auto"))
-        if r.get("kind") == "ckpt":
+            path = os.path.join(dir, r.get("path", "auto"))
+            if validate(path):
+                return path
+            # the single auto slot is damaged: keep scanning — an
+            # older script ckpt generation may still be restorable
+        if r.get("kind") == "ckpt" and _ckpt_usable(dir, r):
             return os.path.join(dir, f"ckpt-{int(r['seq']):05d}")
     return None
 

@@ -79,6 +79,10 @@ class OinkScript:
         self._ft_skip = 0
         self._ft_restore: Optional[tuple] = None   # (ckpt record, dir)
         self._ft_resuming = False
+        # resume_into sets this when the restored checkpoint was taken
+        # on a DIFFERENT mesh width than this interpreter runs — the
+        # serve/ daemon surfaces it as meta.resharded (degraded mode)
+        self._ft_resharded = False
         self._ft_depth = 0
         self._ft_pending_begin: Optional[tuple] = None
 

@@ -193,7 +193,14 @@ def _dest_fn(dest, nprocs: int, mesh) -> Callable:
     * ("fixed_mod", n) — every row of shard i to shard i%n: the
       reference gather's EXACT sender→receiver mapping ("lo procs recv
       from set of hi procs with same (ID % numprocs)",
-      src/mapreduce.cpp:919-928)."""
+      src/mapreduce.cpp:919-928);
+    * ("range", offsets, ends) — topology resharding (reshard.py):
+      row r of shard i has GLOBAL index offsets[i]+r; it routes to the
+      target shard whose cumulative row range covers that index
+      (``searchsorted(ends, g, "right")``).  The redistribution
+      schedule (offsets/ends, both hashable tuples) is computed
+      host-side from the counts — the data itself moves only through
+      the collective, the 2112.01075 recipe."""
     kind = dest[0]
     if kind == "hash":
         fn = dest[1]
@@ -208,6 +215,19 @@ def _dest_fn(dest, nprocs: int, mesh) -> Callable:
             d = (me % n).astype(jnp.int32)
             return jnp.full(keys.shape[0], d, jnp.int32)
         return fixed
+    if kind == "range":
+        offsets, ends = dest[1], dest[2]
+
+        def ranged(keys):
+            me = flat_axis_index(mesh)
+            offs = jnp.asarray(offsets, jnp.int64)
+            g = offs[me] + jnp.arange(keys.shape[0], dtype=jnp.int64)
+            # dest is monotone in the row index, so phase1's stable
+            # dest-sort is the identity and the packed output preserves
+            # exact global row order — reshard's byte-identity contract
+            return jnp.searchsorted(jnp.asarray(ends, jnp.int64), g,
+                                    side="right").astype(jnp.int32)
+        return ranged
     raise ValueError(dest)
 
 

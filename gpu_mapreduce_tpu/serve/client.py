@@ -69,12 +69,15 @@ class ServeClient:
     # -- API ---------------------------------------------------------------
     def submit(self, script: Optional[str] = None,
                ops: Optional[list] = None,
-               tenant: str = "default") -> dict:
+               tenant: str = "default",
+               priority: Optional[int] = None) -> dict:
         body: dict = {"tenant": tenant}
         if script is not None:
             body["script"] = script
         if ops is not None:
             body["ops"] = ops
+        if priority is not None:
+            body["priority"] = int(priority)
         return self._req("POST", "/v1/jobs", body)
 
     def jobs(self) -> list:

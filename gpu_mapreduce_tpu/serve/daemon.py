@@ -93,6 +93,15 @@ class Server:
             else os.environ.get("MRTPU_SERVE_PAUSED", "") == "1"
         self.comm = comm
         self.queue = AdmissionQueue(cap)
+        # per-tenant request-rate quota (ROADMAP item 1): 0 = off
+        from .admission import TenantRateLimiter
+        self.ratelimit = TenantRateLimiter(
+            env_knob("MRTPU_SERVE_RATE", float, 0.0),
+            env_knob("MRTPU_SERVE_BURST", float, None))
+        # session TTL/GC: done/failed session state past this age is
+        # swept by a background thread (0 = keep forever)
+        self.ttl_s = max(0.0, env_knob("MRTPU_SERVE_TTL", float, 0.0))
+        self.gc_count = 0
         self.budgets = budgets or TenantBudgets()
         self.sessions: Dict[str, Session] = {}
         self._order: List[str] = []        # admission order, for /v1/jobs
@@ -141,6 +150,10 @@ class Server:
                                      daemon=True)
                 t.start()
                 self._workers.append(t)
+        if self.ttl_s > 0:
+            t = threading.Thread(target=self._gc_loop,
+                                 name="mrtpu-serve-gc", daemon=True)
+            t.start()
         return self.port
 
     def _warm_imports(self) -> None:
@@ -159,14 +172,25 @@ class Server:
     def _recover(self) -> None:
         """Replay the serve journal: accepted-but-unfinished sessions
         re-enter the queue in admission order (``force=True`` — the
-        journal's accept beats the restart's queue cap); finished ones
-        reload as DONE/FAILED stubs whose results serve from disk."""
+        journal's accept beats the restart's queue cap) at their
+        recorded priority, ONTO WHATEVER MESH this restart carries —
+        degraded-mode recovery: a daemon restarted with fewer (or more)
+        devices still finishes every accepted session, and a resumed
+        session whose checkpoint came from a different mesh width
+        reports ``meta.resharded`` (ft/journal.resume_into).  Finished
+        sessions reload as DONE/FAILED stubs whose results serve from
+        disk; GC'd sessions (``serve_gc`` intent records) are neither
+        listed nor replayed, and their leftover directories are swept
+        to completion (a kill -9 mid-GC resumes the delete, never
+        orphans a live session — live sessions are never journaled for
+        GC in the first place)."""
         from ..ft.journal import read_journal
         try:
             recs = read_journal(self.state_dir)
         except MRError:
             return
         done: Dict[str, str] = {}
+        gcd: set = set()
         submits: List[dict] = []
         for r in recs:
             if r.get("kind") == "serve_submit":
@@ -174,20 +198,32 @@ class Server:
                 self._seq = max(self._seq, int(r.get("seq", 0)))
             elif r.get("kind") == "serve_done":
                 done[r.get("sid", "")] = r.get("status", DONE)
+            elif r.get("kind") == "serve_gc":
+                gcd.add(r.get("sid", ""))
         for r in submits:
             sid = r["sid"]
             if done.get(sid) == "rejected":
                 # compensated submit (a shutdown race): the client was
                 # told "not accepted" — never replay or list it
                 continue
+            if sid in gcd:
+                self._gc_files(sid)       # finish an interrupted GC
+                continue
             sess = Session(sid=sid, tenant=r.get("tenant", "default"),
                            payload=r.get("payload", ""),
                            fmt=r.get("fmt", "oink"),
-                           submitted_utc=r.get("utc", ""))
+                           submitted_utc=r.get("utc", ""),
+                           priority=int(r.get("priority", 0)))
             if sid in done:
                 sess.state = done[sid]
+                try:    # TTL ages from the durable result's mtime
+                    sess.finished_ts = os.path.getmtime(
+                        self.result_path(sid))
+                except OSError:
+                    sess.finished_ts = time.time()
             else:
-                self.queue.offer(sess, force=True)
+                self.queue.offer(sess, force=True,
+                                 priority=sess.priority)
             with self._lock:
                 self.sessions[sid] = sess
                 self._order.append(sid)
@@ -234,19 +270,34 @@ class Server:
             return 400, {"error": str(e)}, None
         tenant = str(body.get("tenant") or "default")
         fmt = "ops" if body.get("ops") is not None else "oink"
+        try:
+            # clamp: priority is a scheduling hint, not a weapon
+            priority = max(-9, min(9, int(body.get("priority") or 0)))
+        except (TypeError, ValueError):
+            return 400, {"error": "priority must be an integer"}, None
+        # per-tenant rate quota BEFORE the shared queue: a throttled
+        # tenant's Retry-After reflects its OWN bucket, and its 429
+        # never consumes shared queue capacity
+        ok, ra = self.ratelimit.check(tenant)
+        if not ok:
+            self._metric_admission("throttled", tenant)
+            return 429, {"error": f"tenant {tenant!r} over its "
+                                  f"request rate"}, \
+                {"Retry-After": max(1, int(ra + 0.999))}
         with self._submit_lock:
             if self._journal is None:       # shutdown closed it
                 return 503, {"error": "shutting down"}, \
                     {"Retry-After": 60}
             if self.queue.full():
                 self.queue.reject()
-                self._metric_admission("rejected")
+                self._metric_admission("rejected", tenant)
                 return 429, {"error": "admission queue full"}, \
                     {"Retry-After": self.retry_after()}
             self._seq += 1
             sid = f"s{self._seq:06d}"
             sess = Session(
                 sid=sid, tenant=tenant, payload=payload, fmt=fmt,
+                priority=priority,
                 submitted_utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()))
             # the journal record lands BEFORE the queue sees the
@@ -257,8 +308,9 @@ class Server:
             self._journal.append(
                 {"kind": "serve_submit", "sid": sid, "tenant": tenant,
                  "fmt": fmt, "payload": payload, "seq": self._seq,
-                 "utc": sess.submitted_utc})
-            if not self.queue.offer(sess, force=True):
+                 "priority": priority, "utc": sess.submitted_utc})
+            if not self.queue.offer(sess, force=True,
+                                    priority=priority):
                 # capacity is held by the submit lock, so the only way
                 # force-offer fails is a shutdown() that closed the
                 # queue after the drain check above — compensate the
@@ -271,7 +323,7 @@ class Server:
             with self._lock:
                 self.sessions[sid] = sess
                 self._order.append(sid)
-        self._metric_admission("accepted")
+        self._metric_admission("accepted", tenant)
         return 202, {"id": sid, "state": QUEUED, "tenant": tenant}, None
 
     def retry_after(self) -> int:
@@ -280,15 +332,80 @@ class Server:
         per = self._ewma_wall / max(1, len(self._workers) or 1)
         return max(1, int(self.queue.depth() * per + 0.5))
 
-    def _metric_admission(self, outcome: str) -> None:
+    def _metric_admission(self, outcome: str, tenant: str = "default"
+                          ) -> None:
         try:
             from ..obs.metrics import get_registry
             get_registry().counter(
                 "mrtpu_serve_admission_total",
-                "admission decisions by outcome",
-                ("outcome",)).inc(outcome=outcome)
+                "admission decisions by outcome and tenant "
+                "(accepted/rejected/throttled)",
+                ("outcome", "tenant")).inc(outcome=outcome,
+                                           tenant=tenant)
         except Exception:
             pass
+
+    # -- session TTL / GC --------------------------------------------------
+    def _gc_files(self, sid: str) -> None:
+        """Delete one session's durable footprint (idempotent — also
+        the recovery path that finishes an interrupted GC)."""
+        import shutil
+        shutil.rmtree(self.session_dir(sid), ignore_errors=True)
+        try:
+            os.remove(self.result_path(sid))
+        except OSError:
+            pass
+
+    def _gc_once(self) -> int:
+        """One TTL sweep: journal the GC intent per expired DONE/FAILED
+        session FIRST (the intent record is what makes a kill -9
+        mid-delete resumable — and only terminal sessions are ever
+        journaled, so a live session can never be orphaned), then
+        delete its directories and drop it from the listing."""
+        if self.ttl_s <= 0:
+            return 0
+        now = time.time()
+        expired: List[Session] = []
+        with self._lock:
+            for sess in self.sessions.values():
+                if sess.state in (DONE, FAILED) and \
+                        sess.finished_ts is not None and \
+                        now - sess.finished_ts >= self.ttl_s:
+                    expired.append(sess)
+        n = 0
+        for sess in expired:
+            with self._submit_lock:
+                if self._journal is None:
+                    return n           # shutting down: next restart GCs
+                self._journal.append({"kind": "serve_gc",
+                                      "sid": sess.sid,
+                                      "tenant": sess.tenant})
+            self._gc_files(sess.sid)
+            with self._lock:
+                self.sessions.pop(sess.sid, None)
+                try:
+                    self._order.remove(sess.sid)
+                except ValueError:
+                    pass
+                self.gc_count += 1
+            n += 1
+            try:
+                from ..obs.metrics import get_registry
+                get_registry().counter(
+                    "mrtpu_serve_gc_total",
+                    "expired sessions swept by the TTL GC",
+                    ("tenant",)).inc(tenant=sess.tenant)
+            except Exception:
+                pass
+        return n
+
+    def _gc_loop(self) -> None:
+        interval = max(0.2, min(self.ttl_s / 4.0, 60.0))
+        while not self._stopped.wait(interval):
+            try:
+                self._gc_once()
+            except Exception:
+                pass               # the GC must never take the daemon down
 
     # -- workers -----------------------------------------------------------
     def _worker_loop(self) -> None:
@@ -314,6 +431,7 @@ class Server:
                 sess.state = FAILED    # after the durable result, like
                 #                        run_session's flip ordering
             finally:
+                sess.finished_ts = time.time()   # the TTL GC's clock
                 with self._lock:
                     self._active -= 1
             self._ewma_wall = 0.7 * self._ewma_wall + \
@@ -350,6 +468,14 @@ class Server:
         with self._lock:
             return self._active
 
+    def _mesh_width(self) -> int:
+        """Shards of the mesh this daemon instance runs sessions on —
+        after a degraded restart this is "whatever is available now"."""
+        if self.comm is None or isinstance(self.comm, int):
+            return 1
+        from ..parallel.mesh import mesh_axis_size
+        return mesh_axis_size(self.comm)
+
     # -- reads -------------------------------------------------------------
     def status(self, sid: str) -> Optional[dict]:
         with self._lock:
@@ -385,6 +511,9 @@ class Server:
                 "sessions": {"active": active, "by_state": states,
                              "total": len(self._order)},
                 "tenants": self.budgets.snapshot(),
+                "ratelimit": self.ratelimit.snapshot(),
+                "gc": {"ttl_s": self.ttl_s, "swept": self.gc_count},
+                "mesh": {"nprocs": self._mesh_width()},
                 "plan": cache_stats(),
                 "draining": self._draining, "paused": self.paused,
                 "workers": len(self._workers), "port": self.port,

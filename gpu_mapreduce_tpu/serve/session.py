@@ -83,13 +83,17 @@ class Session:
     error: Optional[str] = None
     wall_s: Optional[float] = None
     resumed: bool = False
+    priority: int = 0             # admission priority (higher first)
+    resharded: bool = False       # resumed onto a different mesh width
+    finished_ts: Optional[float] = None   # TTL GC clock (epoch seconds)
 
     def summary(self) -> dict:
         return {"id": self.sid, "tenant": self.tenant,
                 "state": self.state,
                 "submitted_utc": self.submitted_utc,
                 "wall_s": self.wall_s, "error": self.error,
-                "resumed": self.resumed}
+                "resumed": self.resumed, "priority": self.priority,
+                "resharded": self.resharded}
 
 
 def normalize_payload(body: dict) -> str:
@@ -215,7 +219,14 @@ def run_session(server, sess: Session) -> dict:
     try:
         with page_account_scope(acct):
             if sess.resumed:
+                # degraded-mode recovery: the replay runs on WHATEVER
+                # mesh this daemon instance carries; resume_into flags
+                # a checkpoint taken on a different width (the restored
+                # frames are host-side, so the restore itself is
+                # topology-portable — doc/serve.md#recovery)
                 resume_into(script, sdir)
+                sess.resharded = bool(getattr(script, "_ft_resharded",
+                                              False))
             else:
                 script._ft_journal = Journal(sdir, script_mode=True)
                 try:
@@ -262,6 +273,7 @@ def run_session(server, sess: Session) -> dict:
         "meta": {
             "wall_s": sess.wall_s,
             "resumed": sess.resumed,
+            "resharded": sess.resharded,
             "dispatches": global_counters().snapshot()["ndispatch"] - nd0,
             "plan_cache": stats_delta(cache_before),
             "pages": acct.snapshot(),

@@ -1,0 +1,146 @@
+"""End-to-end integrity of durable artifacts: digest on write, verify
+on read.
+
+The reference trusts its page files completely — a bit flip in a spool
+file is silently sorted into the output (SURVEY.md §5 has no integrity
+story at all).  Every durable artifact this repo writes now carries a
+checksum stamped at write time and checked on the read path:
+
+* **checkpoint frames** (``core/checkpoint.py``) — per-frame file
+  digests plus per-shard row digests in the manifest; a bit-flipped
+  frame raises :class:`IntegrityError` at load instead of feeding
+  garbage into the restore, and ``ft.resume`` falls back to the
+  previous kept checkpoint generation;
+* **spill runs** (``core/external.py`` via ``exec/spill.atomic_save``)
+  — the run's writer records the exact bytes it put on disk; the k-way
+  merge verifies the file before its first block is consumed, and a
+  mismatch retries under the existing ``spill.read`` budget (the
+  transient-vs-fatal machinery decides the disposition);
+* **journal records** (``ft/journal.py``) — every JSONL record carries
+  a crc of its own payload; a torn or bit-flipped record is quarantined
+  by ``read_journal`` (skipped + counted) instead of replaying garbage.
+
+Every detection increments ``mrtpu_integrity_failures_total{artifact}``
+(artifact: ``checkpoint`` | ``spill`` | ``journal``) whether or not the
+metrics registry was armed first — corruption evidence must never
+depend on observability ordering.
+
+Digests are crc32 (zlib — always available; the label is explicit in
+the stamp so a future crc32c/sha256 upgrade stays readable:
+``"crc32:xxxxxxxx"``).  Verification is governed by ``MRTPU_VERIFY``
+(default **on**; ``MRTPU_VERIFY=0`` skips the read-side checks —
+stamps are always written, so the knob can be flipped on later without
+rewriting artifacts).  Artifacts written before this layer carry no
+stamp and verify as a no-op (back-compat).
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import Optional
+
+_LABEL = "crc32"
+
+
+class IntegrityError(OSError):
+    """A durable artifact failed its checksum.  Subclasses ``OSError``
+    on purpose: the ft/ classifier treats OSError as transient, so a
+    corrupt spill run retries under the ``spill.read`` budget (page-
+    cache flukes recover; persistent corruption exhausts the budget and
+    surfaces as a loud MRError naming the site)."""
+
+    def __init__(self, artifact: str, path: str, expected: str,
+                 actual: str):
+        super().__init__(
+            f"integrity: {artifact} {path!r} checksum mismatch "
+            f"(expected {expected}, read {actual})")
+        self.artifact = artifact
+        self.path = path
+        self.ft_site = {"spill": "spill.read",
+                        "checkpoint": "checkpoint.save"}.get(artifact,
+                                                             artifact)
+
+
+def verify_enabled() -> bool:
+    """The ``MRTPU_VERIFY`` knob: read-side checksum verification,
+    default ON (stamping is always on — it is the cheap half)."""
+    return os.environ.get("MRTPU_VERIFY", "1") != "0"
+
+
+def digest_bytes(data) -> str:
+    """Stamp of a bytes-like payload."""
+    return f"{_LABEL}:{zlib.crc32(bytes(data)) & 0xFFFFFFFF:08x}"
+
+
+def array_digest(*arrays) -> str:
+    """Stamp of one or more ndarrays' raw row bytes (C-order), chained
+    — the per-shard digest of checkpoint manifests."""
+    import numpy as np
+    c = 0
+    for arr in arrays:
+        a = np.ascontiguousarray(np.asarray(arr))
+        c = zlib.crc32(a.view(np.uint8).reshape(-1).data, c)
+    return f"{_LABEL}:{c & 0xFFFFFFFF:08x}"
+
+
+def file_digest(path: str, chunk: int = 1 << 20) -> str:
+    """Stream-crc of a file's bytes in bounded pieces (a multi-GB spill
+    run verifies without spiking resident memory)."""
+    c = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            c = zlib.crc32(block, c)
+    return f"{_LABEL}:{c & 0xFFFFFFFF:08x}"
+
+
+class ChecksumWriter:
+    """Wrap a binary file handle, crc-ing every byte written through it
+    — the write-side stamp costs no read-back pass.  Only sequential
+    writers may use it (``np.save`` is; zip-based ``np.savez`` seeks
+    and must digest via :func:`file_digest` instead)."""
+
+    def __init__(self, fh):
+        self._fh = fh
+        self._crc = 0
+
+    def write(self, data) -> int:
+        b = bytes(data)
+        self._crc = zlib.crc32(b, self._crc)
+        return self._fh.write(b)
+
+    def digest(self) -> str:
+        return f"{_LABEL}:{self._crc & 0xFFFFFFFF:08x}"
+
+    def __getattr__(self, name):
+        return getattr(self._fh, name)
+
+
+def record_integrity_failure(artifact: str) -> None:
+    """Bump ``mrtpu_integrity_failures_total{artifact}``.  Direct
+    counter feed (like ``obs.metrics.note_trace_rotated``): corruption
+    counts even before ``enable_metrics`` armed the bridges, and a
+    metrics bug must never mask the detection that reported it."""
+    try:
+        from ..obs.metrics import get_registry
+        get_registry().counter(
+            "mrtpu_integrity_failures_total",
+            "durable artifacts that failed checksum verification on "
+            "read, by artifact kind", ("artifact",)).inc(artifact=artifact)
+    except Exception:
+        pass
+
+
+def verify_file(path: str, expected: Optional[str], artifact: str) -> None:
+    """Verify a file against its recorded stamp: no-op when the stamp
+    is absent (pre-integrity artifact) or ``MRTPU_VERIFY=0``; raises
+    :class:`IntegrityError` (and counts the failure) on mismatch."""
+    if expected is None or not verify_enabled():
+        return
+    actual = file_digest(path)
+    if actual != expected:
+        record_integrity_failure(artifact)
+        raise IntegrityError(artifact, path, expected, actual)

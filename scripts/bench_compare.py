@@ -62,6 +62,12 @@ ADVISORY_METRICS = (
     ("serve_cold_sec", -1),
     ("serve_warm_sec", -1),
     ("serve_warm_plan_misses", -1),
+    # elastic rows (bench.py --elastic): reshard wall + the MRTPU_VERIFY
+    # read-side overhead — advisory because both run tiny CPU workloads
+    # whose wall is noisy; the hard invariants (byte-identity, ≤5%
+    # verify budget) are asserted by tests/test_elastic.py
+    ("elastic_reshard_sec", -1),
+    ("elastic_verify_overhead_pct", -1),
 )
 
 DEFAULT_WINDOW = 3
@@ -128,6 +134,14 @@ def record_metrics(rec: dict) -> Optional[dict]:
         pm = (sa.get("warm") or {}).get("plan_misses")
         if pm is not None:
             m["serve_warm_plan_misses"] = pm
+    el = det.get("elastic") or {}
+    if not el.get("error"):
+        walls = [v for k, v in el.items()
+                 if k.startswith("reshard_to_") and v is not None]
+        if walls:
+            m["elastic_reshard_sec"] = round(sum(walls), 4)
+        if el.get("verify_overhead_pct") is not None:
+            m["elastic_verify_overhead_pct"] = el["verify_overhead_pct"]
     # corpus shape must match for wall times to be comparable at all
     # (normalized: older rounds predate the skew/dense keys)
     corpus = det.get("corpus")

@@ -41,7 +41,20 @@ run_ft_subset() {
 run_serve_subset_quick() {
   echo "== serve API round-trip + admission subset (fast) =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
-      -k 'roundtrip or admission or drain or queue_bounds or plan_cache' \
+      -k 'roundtrip or admission or drain or queue_bounds or plan_cache or rate_limit' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_elastic_subset_quick() {
+  echo "== elastic subset (fast): reshard unit + manifest round-trip =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
+      -k 'reshard or manifest' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_elastic_subset_full() {
+  echo "== elastic subset (full): cross-mesh resume goldens + integrity =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
@@ -65,6 +78,7 @@ if [ "${1:-}" = "quick" ]; then
   run_exec_subset
   run_ft_subset
   run_serve_subset_quick
+  run_elastic_subset_quick
   bench_compare_advisory
   exit 0
 fi
@@ -84,4 +98,5 @@ run_metrics_subset
 run_exec_subset
 run_ft_subset
 run_serve_subset_full
+run_elastic_subset_full
 bench_compare_advisory

@@ -485,6 +485,55 @@ def main():
             finally:
                 ft.reset()
 
+            # kill-and-resume-ELSEWHERE (ISSUE 8): a journaled script
+            # killed mid-run by an injected fatal resumes onto a mesh
+            # of a DIFFERENT width; the tail's per-shard output files
+            # must be byte-identical to an uninterrupted run on that
+            # target width (topology-portable checkpoints)
+            from gpu_mapreduce_tpu.ft.inject import InjectedFatal
+            from gpu_mapreduce_tpu.oink.script import OinkScript
+            alt = max(1, nmesh // 2) if nmesh > 1 else \
+                min(2, len(jax.devices()))
+            if alt != nmesh:
+                jdir = os.path.join(tmp, "journal")
+                sc = (f"mr a\n"
+                      f"wordfreq 5 -i {files[0]} -o {tmp}/kw1 NULL\n"
+                      f"wordfreq 5 -i {files[1]} -o {tmp}/kw2 NULL\n")
+                os.environ["MRTPU_JOURNAL"] = jdir
+                os.environ["MRTPU_CKPT_EVERY"] = "1"
+                ft.schedule(site="ingest.read", kind="fatal", rate=1.0,
+                            after=1, max_faults=1)
+                try:
+                    try:
+                        OinkScript(comm=mesh, screen=False
+                                   ).run_string(sc)
+                        raise AssertionError(
+                            "chaos kill never fired")
+                    except InjectedFatal:
+                        pass
+                finally:
+                    ft.reset()
+                    os.environ.pop("MRTPU_JOURNAL", None)
+                    os.environ.pop("MRTPU_CKPT_EVERY", None)
+                amesh = make_mesh(alt)
+                s = ft.resume(jdir, mesh=amesh)
+                OinkScript(comm=amesh, screen=False).run_string(
+                    f"mr a\n"
+                    f"wordfreq 5 -i {files[0]} -o {tmp}/cw1 NULL\n"
+                    f"wordfreq 5 -i {files[1]} -o {tmp}/cw2 NULL\n")
+                import glob as _glob
+
+                def fam(prefix):
+                    return {os.path.basename(p).rsplit(".", 1)[-1]:
+                            open(p).read() for p in
+                            sorted(_glob.glob(prefix + "*"))}
+                assert fam(f"{tmp}/kw2") == fam(f"{tmp}/cw2"), \
+                    "resume-elsewhere tail diverged"
+                published["chaos_resume_elsewhere_ok"] = 1
+                published["chaos_resume_width"] = alt
+                print(f"chaos resume-elsewhere: {nmesh}→{alt} shards, "
+                      f"tail byte-identical")
+
     def do_serve():
         # MR-as-a-service row (serve/): N concurrent clients hammer an
         # in-process daemon with the same wordfreq workload — requests

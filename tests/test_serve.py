@@ -570,3 +570,200 @@ def test_mrctl_cli(server, tmp_path, capsys):
     # state-dir discovery path (ephemeral daemon, serve.json)
     rc = mrctl.main(["--state", server.state_dir, "status"])
     assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic-recovery satellites: quotas, priority, TTL GC, degraded mode
+# ---------------------------------------------------------------------------
+
+def test_admission_queue_priority_order():
+    q = AdmissionQueue(8)
+    q.offer("low1", priority=0)
+    q.offer("hi", priority=5)
+    q.offer("low2", priority=0)
+    q.offer("mid", priority=2)
+    assert [q.take(0) for _ in range(4)] == ["hi", "mid", "low1", "low2"]
+
+
+def test_tenant_rate_limiter_isolated_buckets():
+    from gpu_mapreduce_tpu.serve.admission import TenantRateLimiter
+    rl = TenantRateLimiter(rate=1.0, burst=2)
+    now = 1000.0
+    assert rl.check("a", now)[0] and rl.check("a", now)[0]
+    ok, ra = rl.check("a", now)          # bucket drained
+    assert not ok and 0 < ra <= 1.0
+    assert rl.check("b", now)[0], "tenant b must not share a's bucket"
+    ok, _ = rl.check("a", now + 1.0)     # one token refilled
+    assert ok
+    assert TenantRateLimiter(rate=0.0).check("x")[0]   # 0 = off
+
+
+def test_rate_limited_submit_429_per_tenant(tmp_path):
+    """A tenant past its rate gets 429 + its OWN Retry-After; other
+    tenants are untouched; decisions land in the per-tenant metric."""
+    from gpu_mapreduce_tpu.serve.admission import TenantRateLimiter
+    srv = Server(port=0, workers=0, paused=True,
+                 state_dir=str(tmp_path / "state"))
+    srv.ratelimit = TenantRateLimiter(rate=0.001, burst=1)
+    srv.start()
+    try:
+        c = client(srv)
+        assert c.submit(script="mr x\n", tenant="noisy")["id"]
+        with pytest.raises(ServeError) as ei:
+            c.submit(script="mr x\n", tenant="noisy")
+        assert ei.value.code == 429
+        assert ei.value.retry_after >= 1
+        # a different tenant is admitted right through
+        assert c.submit(script="mr x\n", tenant="quiet")["id"]
+        from gpu_mapreduce_tpu.obs.metrics import get_registry
+        m = get_registry().counter("mrtpu_serve_admission_total", "",
+                                   ("outcome", "tenant"))
+        assert m.value(outcome="throttled", tenant="noisy") >= 1
+        assert m.value(outcome="accepted", tenant="quiet") >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_submit_priority_recorded_and_replayed(tmp_path):
+    """Priority rides the journal: a paused daemon's replayed queue
+    drains high-priority sessions first on restart."""
+    state = str(tmp_path / "state")
+    srv = Server(port=0, workers=0, paused=True, state_dir=state)
+    srv.start()
+    try:
+        c = client(srv)
+        lo = c.submit(script="mr x\n", priority=0)["id"]
+        hi = c.submit(script="mr x\n", priority=7)["id"]
+        assert c.status(hi)["priority"] == 7
+    finally:
+        srv.shutdown()
+    srv2 = Server(port=0, workers=0, paused=True, state_dir=state)
+    srv2.start()
+    try:
+        first = srv2.queue.take(0)
+        assert first.sid == hi and first.priority == 7
+        assert srv2.queue.take(0).sid == lo
+    finally:
+        srv2.shutdown()
+
+
+def test_session_ttl_gc_journaled(tmp_path):
+    """Done sessions past MRTPU_SERVE_TTL are swept — journaled intent
+    first, dirs+result removed, dropped from the listing — and a
+    restart neither lists nor replays them (the GC'd sid is terminal)."""
+    state = str(tmp_path / "state")
+    srv = Server(port=0, workers=1, state_dir=state)
+    srv.ttl_s = 0.05
+    srv.start()
+    try:
+        c = client(srv)
+        sid = c.submit(script="mr x\n")["id"]
+        assert c.wait(sid)["status"] == "done"
+        sdir = srv.session_dir(sid)
+        assert os.path.isdir(sdir)
+        time.sleep(0.08)
+        assert srv._gc_once() == 1
+        assert not os.path.exists(sdir)
+        assert not os.path.exists(srv.result_path(sid))
+        with pytest.raises(ServeError) as ei:
+            c.status(sid)
+        assert ei.value.code == 404
+        from gpu_mapreduce_tpu.ft.journal import read_journal
+        kinds = [r["kind"] for r in read_journal(state)]
+        assert "serve_gc" in kinds
+    finally:
+        srv.shutdown()
+    # a live (queued/running) session is never GC'd and a restart
+    # neither lists nor replays the swept one
+    srv2 = Server(port=0, workers=0, paused=True, state_dir=state)
+    srv2.start()
+    try:
+        assert sid not in srv2.sessions
+        assert srv2.queue.depth() == 0
+    finally:
+        srv2.shutdown()
+
+
+def test_gc_kill_mid_delete_finishes_on_restart(tmp_path):
+    """kill -9 between the serve_gc intent record and the delete: the
+    restart finishes the sweep instead of resurrecting the session."""
+    state = str(tmp_path / "state")
+    srv = Server(port=0, workers=1, state_dir=state)
+    srv.start()
+    try:
+        c = client(srv)
+        sid = c.submit(script="mr x\n")["id"]
+        assert c.wait(sid)["status"] == "done"
+        # intent journaled, then "killed" before _gc_files ran
+        srv._journal.append({"kind": "serve_gc", "sid": sid,
+                             "tenant": "default"})
+    finally:
+        srv.shutdown()
+    assert os.path.isdir(os.path.join(state, "sessions", sid))
+    srv2 = Server(port=0, workers=0, paused=True, state_dir=state)
+    srv2.start()
+    try:
+        assert sid not in srv2.sessions
+        assert not os.path.exists(os.path.join(state, "sessions", sid))
+    finally:
+        srv2.shutdown()
+
+
+def test_degraded_restart_resumes_on_available_mesh(tmp_path):
+    """Tentpole (4): a session checkpointed on a 4-shard mesh resumes
+    on a daemon restarted with only 2 shards — the recovered tail's
+    files are byte-identical to an uninterrupted 2-shard daemon's run,
+    and the result carries ``meta.resharded``."""
+    from gpu_mapreduce_tpu.ft.journal import Journal
+    from gpu_mapreduce_tpu.oink.script import OinkScript
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+    corpus = write_corpus(tmp_path / "w.txt", ["p", "q", "p", "r"], 25)
+    script_text = (f"variable files index {corpus}\n"
+                   f"wordfreq 3 -i v_files -o NULL wf\n"
+                   f"wordfreq 2 -i v_files -o tmp.out NULL\n")
+
+    gold = Server(port=0, workers=1, comm=make_mesh(2),
+                  state_dir=str(tmp_path / "golden"))
+    gold.start()
+    try:
+        gc = client(gold)
+        golden = gc.wait(gc.submit(script=script_text)["id"])
+    finally:
+        gold.shutdown()
+    assert golden["status"] == "done"
+
+    # manufacture the crashed 4-shard in-flight session (checkpoint
+    # after the first wordfreq, death before the output-writing one)
+    state = str(tmp_path / "state")
+    sdir = os.path.join(state, "sessions", "s000001")
+    outdir = os.path.join(sdir, "out")
+    os.makedirs(outdir, exist_ok=True)
+    crash = OinkScript(comm=make_mesh(4), screen=io.StringIO())
+    crash._ft_journal = Journal(sdir, script_mode=True, every=1)
+    crash._path_prepend = outdir
+    lines = script_text.splitlines()
+    crash._ft_pending_begin = (lines, "<serve>")
+    for ln in lines[:2]:
+        crash.one(ln)
+    crash._ft_journal.close()
+
+    boot = Server(port=0, workers=0, state_dir=state, paused=True)
+    boot.start()
+    try:
+        assert client(boot).submit(script=script_text)["id"] == "s000001"
+    finally:
+        boot.shutdown()
+
+    srv = Server(port=0, workers=1, comm=make_mesh(2), state_dir=state)
+    srv.start()
+    try:
+        assert srv.stats()["mesh"]["nprocs"] == 2
+        res = client(srv).wait("s000001")
+    finally:
+        srv.shutdown()
+    assert res["status"] == "done"
+    assert res["meta"]["resumed"] is True
+    assert res["meta"]["resharded"] is True
+    assert {k: v["sha256"] for k, v in res["files"].items()} == \
+        {k: v["sha256"] for k, v in golden["files"].items()}
