@@ -531,6 +531,64 @@ def serve_ab_record() -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def profile_ab_record() -> dict:
+    """Armed-vs-disarmed cost of the request trace context
+    (obs/context.py): the identical aggregate/sort micro-cycle, best of
+    alternating reps with (a) MRTPU_PROFILE=0 + tracing off and (b) a
+    request_scope + the tracer ring armed.  Recorded as
+    ``detail.profile_ab`` → the advisory ``profile_overhead_pct``
+    bench_compare row — the evidence that the disarmed context layer
+    stays within bench noise (doc/observability.md)."""
+    import numpy as np
+
+    from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+    from gpu_mapreduce_tpu.obs import get_tracer, request_scope
+    from gpu_mapreduce_tpu.obs import context as obs_context
+
+    keys = (np.arange(400_000, dtype=np.uint64) * 2654435761) % (1 << 18)
+
+    def cycle():
+        mr = MapReduce()
+        mr.map(4, lambda i, kv, p: kv.add_batch(keys, keys))
+        mr.aggregate()
+        mr.sort_keys(1)
+
+    tracer = get_tracer()
+    prev_profile = os.environ.get("MRTPU_PROFILE")
+    prev_enabled = tracer.enabled
+    best = {"off": float("inf"), "on": float("inf")}
+    try:
+        cycle()                            # warm shapes/interning
+        for _rep in range(3):              # alternate: ordering noise
+            for mode in ("off", "on"):     # must not read as the knob
+                if mode == "off":
+                    os.environ["MRTPU_PROFILE"] = "0"
+                    tracer.enabled = False
+                    t0 = time.perf_counter()
+                    cycle()
+                    best["off"] = min(best["off"],
+                                      time.perf_counter() - t0)
+                else:
+                    os.environ["MRTPU_PROFILE"] = "1"
+                    tracer.enable()
+                    t0 = time.perf_counter()
+                    with request_scope(label="bench-profile-ab"):
+                        cycle()
+                    best["on"] = min(best["on"],
+                                     time.perf_counter() - t0)
+    finally:
+        if prev_profile is None:
+            os.environ.pop("MRTPU_PROFILE", None)
+        else:
+            os.environ["MRTPU_PROFILE"] = prev_profile
+        tracer.enabled = prev_enabled
+        obs_context.reset()
+    off, on = best["off"], best["on"]
+    return {"off_s": round(off, 4), "on_s": round(on, 4),
+            "overhead_pct": round((on - off) / off * 100.0, 2)
+            if off > 0 else 0.0}
+
+
 _ELASTIC_PROBE = r"""
 import json, os, sys, time, tempfile
 import numpy as np
@@ -716,6 +774,16 @@ def run_bench(engine, backend_err):
             detail["elastic"] = elastic_record()
         except Exception:
             detail["elastic"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if os.environ.get("BENCH_PROFILE_AB", "1") != "0":
+        # trace-context armed-vs-disarmed micro A/B (obs/context.py):
+        # cheap (~seconds), recorded on every round so the advisory
+        # profile_overhead_pct series exists without a flag; failures
+        # must not cost the headline metric line
+        try:
+            detail["profile_ab"] = profile_ab_record()
+        except Exception:
+            detail["profile_ab"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     try:
         print(json.dumps({"detail": detail}), file=sys.stderr)

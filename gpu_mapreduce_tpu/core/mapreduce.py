@@ -494,6 +494,9 @@ class MapReduce:
             return n
         from collections import deque
 
+        from ..obs.context import bind as _ctx_bind
+        ingest_task = _ctx_bind(ingest_task)   # pool tasks charge the
+        #                                        submitting request
         pool = self._ingest_pool()     # shared per-MR executor
         nworkers = pool._max_workers
         window = 4 * nworkers

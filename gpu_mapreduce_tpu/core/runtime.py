@@ -133,6 +133,9 @@ class Counters:
             if acct is not None:
                 acct.note_io(deltas.get("wsize", 0),
                              deltas.get("rsize", 0))
+        feed = _REQUEST_FEED
+        if feed is not None:
+            feed("add", deltas)
 
     def mem(self, delta: int):
         with self._lock:
@@ -142,6 +145,9 @@ class Counters:
         acct = getattr(_ACCOUNT_TLS, "acct", None)
         if acct is not None:
             acct.charge(delta)
+        feed = _REQUEST_FEED
+        if feed is not None:
+            feed("mem", delta)
 
     def snapshot(self) -> dict:
         """Consistent copy of every counter field — the structured twin
@@ -214,6 +220,12 @@ class PageAccount:
                                           / self.page_bytes, 4),
                     "limit_pages": self.limit_pages}
 
+
+# the request-context attribution hook: obs/context.py installs its
+# feed here at import (fn(kind, payload) — "add" with the deltas dict,
+# "mem" with the byte delta).  Module-global instead of an import so
+# core/ never depends on obs/ and the unarmed cost is one None check.
+_REQUEST_FEED = None
 
 _ACCOUNT_TLS = threading.local()
 

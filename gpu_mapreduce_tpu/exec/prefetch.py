@@ -52,6 +52,12 @@ def prefetch_iter(src: Iterable, depth: Optional[int] = None,
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
     state = {"busy": 0.0, "items": 0, "inflight_max": 0}
+    # trace-context handoff (obs/context.py): the producer thread runs
+    # the CONSUMER's request — its exec.prefetch span and any counters
+    # the source iterator bumps must charge the submitting request, not
+    # fall into the anonymous process bucket
+    from ..obs import context as _obs_ctx
+    req_ctx = _obs_ctx.capture()
 
     def _put(msg) -> None:
         # bounded put that gives up when the consumer is gone
@@ -67,8 +73,9 @@ def prefetch_iter(src: Iterable, depth: Optional[int] = None,
         try:
             from ..obs import get_tracer
             it = iter(src)
-            with get_tracer().span("exec.prefetch", cat="exec",
-                                   path=path, depth=depth) as sp:
+            with _obs_ctx.use(req_ctx), \
+                    get_tracer().span("exec.prefetch", cat="exec",
+                                      path=path, depth=depth) as sp:
                 while not stop.is_set():
                     t0 = time.perf_counter()
                     try:

@@ -99,8 +99,15 @@ class SpillWriter:
                     target=self._run, daemon=True,
                     name=f"mrtpu-{self._path}-writer")
                 self._thread.start()
+        # trace-context handoff (obs/context.py): the writer thread is
+        # long-lived and SHARED across requests, so the submitting
+        # request's context rides each queue item — the write's span
+        # and wsize counter bump charge the request that spilled, not
+        # whichever request happened to submit last
+        from ..obs import context as _obs_ctx
+        req_ctx = _obs_ctx.capture()
         t0 = time.perf_counter()
-        self._q.put((fn, pending))
+        self._q.put((fn, pending, req_ctx))
         blocked = time.perf_counter() - t0
         if blocked > 1e-4:
             from . import note_overlap
@@ -108,6 +115,7 @@ class SpillWriter:
         return pending
 
     def _run(self) -> None:
+        from ..obs import context as _obs_ctx
         from ..obs import get_tracer
         from . import note_overlap
         tracer = get_tracer()
@@ -115,11 +123,12 @@ class SpillWriter:
             item = self._q.get()
             if item is None:
                 return
-            fn, pending = item
+            fn, pending, req_ctx = item
             t0 = time.perf_counter()
             try:
-                with tracer.span("exec.spill_write", cat="exec",
-                                 path=self._path):
+                with _obs_ctx.use(req_ctx), \
+                        tracer.span("exec.spill_write", cat="exec",
+                                    path=self._path):
                     fn()
             except BaseException as e:
                 pending._error = e

@@ -112,7 +112,21 @@ class Journal:
         Every record carries a ``"c"`` crc of its own serialized
         payload (utils/integrity.py): a bit-flipped or half-torn line
         is QUARANTINED by :func:`read_journal` instead of replayed —
-        the journal never claims work a corrupt record describes."""
+        the journal never claims work a corrupt record describes.
+
+        Records also carry the active request's ``"trace"`` id
+        (obs/context.py) unless the caller already stamped one — the
+        link that lets ``trace_view --trace`` and a post-mortem connect
+        a journal line back to the request (and its spans/flight dump)
+        that wrote it."""
+        if "trace" not in rec:
+            try:
+                from ..obs.context import current_trace_id
+                tid = current_trace_id()
+            except Exception:
+                tid = None
+            if tid is not None:
+                rec = {**rec, "trace": tid}
         body = json.dumps(rec, default=str)
         line = json.dumps({**json.loads(body), "c": _rec_crc(body)},
                           default=str)

@@ -171,6 +171,14 @@ def classify(site: str, exc: BaseException) -> str:
 def _count(site: str, outcome: str) -> None:
     with _LOCK:
         _RETRIES[(site, outcome)] = _RETRIES.get((site, outcome), 0) + 1
+    # per-request attribution (obs/context.py): the same outcome lands
+    # on the active request account, so a session's cost profile shows
+    # ITS retries, not the process total
+    try:
+        from ..obs.context import note_retry
+        note_retry(site, outcome)
+    except Exception:
+        pass
 
 
 def retry_call(site: str, fn: Callable, *, detail: str = "",
@@ -241,7 +249,16 @@ def _retry_tail(site: str, fn: Callable, first: BaseException, b: int,
 
 def quarantine(site: str, **record) -> None:
     """Record one skipped (poisoned) input; counted exactly, last
-    :data:`_QUARANTINE_KEEP` records kept for ``mr.stats()["ft"]``."""
+    :data:`_QUARANTINE_KEEP` records kept for ``mr.stats()["ft"]``.
+    The record carries the active request's trace id (obs/context.py)
+    so a multi-tenant daemon can say WHOSE input was quarantined."""
+    try:
+        from ..obs.context import current_trace_id
+        tid = current_trace_id()
+    except Exception:
+        tid = None
+    if tid is not None:
+        record.setdefault("trace", tid)
     with _LOCK:
         _NQUAR[site] = _NQUAR.get(site, 0) + 1
         _QUARANTINE.append({"site": site, **record})

@@ -33,6 +33,8 @@ from .sinks import (CallbackSink, JsonlSink, RingSink, chrome_trace,
                     read_jsonl, write_chrome_trace)
 from .report import aggregate_ops, per_op_table
 from .metrics import MetricsRegistry, enable_metrics, get_registry
+from .context import (RequestAccount, current_trace_id, new_trace_id,
+                      request_scope)
 
 __all__ = [
     "Tracer", "Span", "NULL_SPAN", "get_tracer", "configure_from_env",
@@ -40,6 +42,8 @@ __all__ = [
     "chrome_trace", "write_chrome_trace", "read_jsonl",
     "aggregate_ops", "per_op_table",
     "MetricsRegistry", "get_registry", "enable_metrics",
+    "RequestAccount", "request_scope", "current_trace_id",
+    "new_trace_id",
 ]
 
 # apply MRTPU_METRICS_PORT / MRTPU_METRICS_SNAP / MRTPU_FLIGHT once the

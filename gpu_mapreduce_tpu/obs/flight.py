@@ -57,12 +57,18 @@ class FlightRecorder:
     # -- artifact -----------------------------------------------------------
     def snapshot(self, reason: str = "snapshot") -> dict:
         from ..core.runtime import global_counters
+        from .context import current_trace_id
         with self._lock:
             spans = list(self.events)
         doc = {"reason": reason,
                "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                "pid": os.getpid(),
                "argv": list(sys.argv),
+               # the request the dumping thread was serving (None when
+               # no context is active); every ringed span additionally
+               # carries its OWN "trace" id, so a multi-tenant dump
+               # still attributes each span to its request
+               "trace_id": current_trace_id(),
                "counters": global_counters().snapshot(),
                "spans": spans}
         try:

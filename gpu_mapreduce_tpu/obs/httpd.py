@@ -102,6 +102,12 @@ class _Handler(BaseHTTPRequestHandler):
             body = self.rfile.read(n) if n else b""
             code, out, ctype, extra = handler(method, path, body,
                                               dict(self.headers))
+            if callable(getattr(out, "__next__", None)):
+                # a handler returned an ITERATOR body: stream it (the
+                # serve/ daemon's /v1/jobs/<id>/events long-lived feed)
+                self._send_stream(code, out,
+                                  ctype or "application/x-ndjson", extra)
+                return
             if isinstance(out, bytes):
                 payload = out
             elif isinstance(out, str):
@@ -118,6 +124,43 @@ class _Handler(BaseHTTPRequestHandler):
         finally:
             with srv._inflight_lock:
                 srv._inflight -= 1
+
+    def _send_stream(self, code: int, it, ctype: str,
+                     extra: Optional[dict] = None) -> None:
+        """Stream an iterator body chunk by chunk, flushed per chunk.
+        No Content-Length: under the handler's HTTP/1.0 semantics the
+        connection close delimits the body, so a stdlib-urllib client
+        reading line by line sees each chunk as it is produced — the
+        no-polling contract of ``/v1/jobs/<id>/events``.  The iterator
+        is always closed (its ``finally`` is how the producer
+        unsubscribes), including when the client disconnects mid-
+        stream."""
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Cache-Control", "no-store")
+        for k, v in (extra or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        try:
+            for chunk in it:
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                self.wfile.write(chunk)
+                self.wfile.flush()
+        except Exception:
+            # the status line and part of the body are already on the
+            # wire: nothing coherent can follow.  Swallow (producer bug
+            # or client disconnect alike) so the outer handler doesn't
+            # write an HTTP 500 status line INTO the stream body —
+            # ending the connection mid-stream IS the error signal
+            pass
+        finally:
+            close = getattr(it, "close", None)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
 
     def _builtin_get(self, path: str) -> bool:
         """The metrics-plane routes; returns whether ``path`` was one."""

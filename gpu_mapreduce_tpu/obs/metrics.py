@@ -438,6 +438,17 @@ def _collect_exec(reg: MetricsRegistry) -> None:
         g.set(rec["overlap_ratio"], path=path)
 
 
+def _collect_slo(reg: MetricsRegistry) -> None:
+    """Tick the tenant SLO engine (obs/slo.py) at scrape time: windowed
+    burn-rate evaluation over the serve session counters this registry
+    already holds, refreshing ``mrtpu_slo_burn_ratio{tenant,window}``.
+    A no-op when no objectives are configured (MRTPU_SLO unset)."""
+    from . import slo as _slo
+    eng = _slo.get_engine()
+    if eng is not None:
+        eng.tick(reg=reg)
+
+
 def enable_metrics(flight: Optional[bool] = None) -> MetricsRegistry:
     """Wire the automatic feeds (idempotent): subscribe the span bridge
     to the process tracer (this enables tracing), register the Counters
@@ -451,6 +462,7 @@ def enable_metrics(flight: Optional[bool] = None) -> MetricsRegistry:
     reg.register_collector(_collect_plan)
     reg.register_collector(_collect_exec)
     reg.register_collector(_collect_ft)
+    reg.register_collector(_collect_slo)
     from .tracer import get_tracer
     get_tracer().subscribe_once(_bridge_emit)
     _ENABLED = True
@@ -489,6 +501,14 @@ def reset() -> None:
 def record_exchange(stats) -> None:
     """Per-call shuffle telemetry (parallel/shuffle.exchange): useful vs
     padding bytes, flow-control rounds, routed rows."""
+    # the request account's exchange feed runs BEFORE the registry
+    # gate: per-request attribution (obs/context.py) must stay exact
+    # whether or not live metrics are armed
+    try:
+        from .context import note_exchange
+        note_exchange(stats)
+    except Exception:
+        pass
     if not _ENABLED:
         return
     try:

@@ -28,6 +28,9 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .context import current_trace_id as _ctx_trace_id
+from .context import note_span as _ctx_note_span
+
 # Counters fields snapshotted at span entry; the exit delta lands in the
 # span's args under the mapped name (only when nonzero, to keep traces
 # small).  msizemax is a hi-water, not a flow — reported as the absolute
@@ -38,6 +41,7 @@ _DELTA_FIELDS = (
     ("wsize", "spill_write_bytes"),
     ("rsize", "spill_read_bytes"),
     ("commtime", "comm_secs"),
+    ("ndispatch", "dispatches"),
 )
 
 
@@ -69,7 +73,7 @@ class Span:
     """
 
     __slots__ = ("tracer", "name", "cat", "attrs", "span_id", "parent_id",
-                 "t0", "t1", "_snap", "_mem0", "_jax_ctx")
+                 "t0", "t1", "_snap", "_mem0", "_jax_ctx", "trace_id")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, attrs: dict):
         self.tracer = tracer
@@ -82,6 +86,7 @@ class Span:
         self._snap = None
         self._mem0 = 0
         self._jax_ctx = None
+        self.trace_id = None
 
     def set(self, **attrs):
         self.attrs.update(attrs)
@@ -92,6 +97,11 @@ class Span:
         stack = tr._stack()
         self.parent_id = stack[-1].span_id if stack else 0
         stack.append(self)
+        # request-scoped trace context (obs/context.py): the id rides
+        # the event so one request's spans are filterable out of any
+        # sink — including spans emitted from worker threads that
+        # re-installed the submitting request's context
+        self.trace_id = _ctx_trace_id()
         c = tr.counters
         self._snap = tuple(getattr(c, f) for f, _ in _DELTA_FIELDS)
         self._mem0 = c.msizemax
@@ -126,13 +136,17 @@ class Span:
             self.attrs["hbm_hiwater_bytes"] = c.msizemax
         if exc_type is not None:
             self.attrs["error"] = exc_type.__name__
+        # per-request stage profile (obs/context.py): the finished
+        # span's wall + counter deltas land on the active account too —
+        # same numbers, scoped to the request instead of the process
+        _ctx_note_span(self.name, self.cat, self.t1 - self.t0, self.attrs)
         tr._emit(self)
         return False
 
     def event(self) -> dict:
         """This finished span as a Chrome trace-event dict."""
         tr = self.tracer
-        return {
+        ev = {
             "name": self.name, "cat": self.cat, "ph": "X",
             "ts": round((self.t0 - tr.epoch) * 1e6, 1),
             "dur": round((self.t1 - self.t0) * 1e6, 1),
@@ -140,6 +154,9 @@ class Span:
             "id": self.span_id, "parent": self.parent_id,
             "args": self.attrs,
         }
+        if self.trace_id is not None:
+            ev["trace"] = self.trace_id
+        return ev
 
 
 class Tracer:
@@ -230,6 +247,18 @@ class Tracer:
             if not any(isinstance(s, CallbackSink) and s.fn == fn
                        for s in self._sinks):
                 self._sinks.append(CallbackSink(fn))
+
+    def unsubscribe(self, fn) -> None:
+        """Detach a callback sink subscribed via subscribe[_once] (by
+        ``==``, matching subscribe_once's membership rule).  A consumer
+        with a bounded lifetime — the serve/ daemon's per-session event
+        feed — must detach on shutdown or every emission keeps paying
+        for a dead listener."""
+        from .sinks import CallbackSink
+        with self._lock:
+            self._sinks = [s for s in self._sinks
+                           if not (isinstance(s, CallbackSink)
+                                   and s.fn == fn)]
 
     def reset(self) -> None:
         """Drop sinks/events and disable (test isolation)."""

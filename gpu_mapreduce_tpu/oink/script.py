@@ -145,7 +145,20 @@ class OinkScript:
             self._ft_pending_begin = (list(lines), name)
         self._ft_depth += 1
         try:
-            self._run_lines(lines, name)
+            if self._ft_depth == 1:
+                # request-scoped trace context (obs/context.py): a
+                # top-level script run is ONE request — its spans,
+                # journal records and quarantine records all carry one
+                # trace_id.  ensure_scope reuses an enclosing context
+                # (a serve/ session wrapping this script stays one
+                # request) and no-ops under MRTPU_PROFILE=0; nested
+                # include/jump runs arrive at depth > 1 and never
+                # re-scope
+                from ..obs.context import ensure_scope
+                with ensure_scope(label=f"oink:{name}"):
+                    self._run_lines(lines, name)
+            else:
+                self._run_lines(lines, name)
         finally:
             self._ft_depth -= 1
 

@@ -111,8 +111,14 @@ def run_sinks(payloads, call: Callable, threaded: bool = True,
             nworkers = max(1, min((os.cpu_count() or 4), 16,
                                   len(payloads)))
             ctx = ThreadPoolExecutor(nworkers)
+        # pool workers run the SUBMITTING request's trace context
+        # (obs/context.py): their ft fault-point spans and any counter
+        # traffic charge the request, and the pool is shared across
+        # sessions so each task must carry its own binding
+        from ..obs.context import bind as _ctx_bind
+        task = _ctx_bind(ingest_task)
         with ctx as ex:
-            futs = [ex.submit(ingest_task, call, base + i, p, sinks[i],
+            futs = [ex.submit(task, call, base + i, p, sinks[i],
                               onfault=onfault, shard=shard)
                     for i, p in enumerate(payloads)]
             for f in futs:
@@ -331,9 +337,12 @@ def _pooled_file_sink_stream(shards, call: Callable, pool,
     names = [f for files in shards for f in files]
     shard_of = [s for s, files in enumerate(shards) for _ in files]
     sinks = [_TaskSink() for _ in names]
+    from ..obs.context import bind as _ctx_bind
+    task = _ctx_bind(ingest_task)   # shared pool: each task carries the
+    #                                 submitting request's trace context
     with get_tracer().span("ingest.read", cat="ingest",
                            ntasks=len(names), threaded=True):
-        futs = [pool.submit(ingest_task, call, i, name, sinks[i],
+        futs = [pool.submit(task, call, i, name, sinks[i],
                             onfault=onfault, shard=shard_of[i])
                 for i, name in enumerate(names)]
         i = 0

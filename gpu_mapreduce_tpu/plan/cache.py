@@ -44,9 +44,20 @@ class LRUCache:
             if key in self._d:
                 self._d.move_to_end(key)
                 self.hits += 1
-                return self._d[key]
-            self.misses += 1
-            return None
+                hit = self._d[key]
+            else:
+                self.misses += 1
+                hit = None
+        # per-request attribution (obs/context.py): the same hit/miss
+        # lands on the active request account, so a serve session's
+        # "did this recompile?" is ITS delta even with concurrent
+        # neighbors warming the same process-global cache
+        try:
+            from ..obs.context import note_plan
+            note_plan(self.name, hit is not None)
+        except Exception:
+            pass
+        return hit
 
     def put(self, key, value) -> None:
         with self._lock:

@@ -106,6 +106,42 @@ class ServeClient:
                                        f"{timeout}s"})
             time.sleep(poll_s)
 
+    def profile(self, sid: str) -> dict:
+        """The per-request cost profile (live while running, durable
+        once finished — doc/serve.md)."""
+        return self._req("GET", f"/v1/jobs/{sid}/profile")
+
+    def events(self, sid: str, timeout: Optional[float] = None):
+        """Generator over ``GET /v1/jobs/<id>/events``: one dict per
+        streamed JSON line (status transitions, top-level spans, the
+        final profile) until the stream ends — ONE HTTP request, no
+        polling.  ``timeout`` is the per-read socket timeout (the
+        server heartbeats every ~15 s, so a dead daemon surfaces as an
+        OSError rather than a hang)."""
+        req = urllib.request.Request(self.base + f"/v1/jobs/{sid}/events")
+        try:
+            r = urllib.request.urlopen(
+                req, timeout=timeout if timeout is not None else 60.0)
+        except urllib.error.HTTPError as e:
+            raw = e.read().decode(errors="replace")
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                body = {"error": raw}
+            raise ServeError(e.code, body) from None
+        with r:
+            for line in r:
+                line = line.decode(errors="replace").strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    continue    # torn final line on daemon stop
+
+    def slo(self) -> dict:
+        return self._req("GET", "/v1/slo")
+
     def stats(self) -> dict:
         return self._req("GET", "/v1/stats")
 

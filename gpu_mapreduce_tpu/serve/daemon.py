@@ -34,6 +34,7 @@ HTTP API (all JSON; see doc/serve.md):
 from __future__ import annotations
 
 import os
+import queue as _queue
 import threading
 import time
 from typing import Dict, List, Optional
@@ -115,6 +116,12 @@ class Server:
         self._ewma_wall = 1.0              # Retry-After estimator
         self._journal = None
         self._owns_httpd = False
+        # request-scoped observability (obs/context.py): trace_id →
+        # sid routing for the span feed, and per-session watcher queues
+        # behind /v1/jobs/<id>/events
+        self._watch: Dict[str, List] = {}
+        self._trace_sids: Dict[str, str] = {}
+        self._watch_lock = threading.Lock()
 
     # -- paths -------------------------------------------------------------
     def session_dir(self, sid: str) -> str:
@@ -134,6 +141,11 @@ class Server:
         from ..obs import httpd, metrics
         reg = metrics.enable_metrics()
         reg.register_collector(_collect_serve)
+        # the span→events feed: finished top-level spans route to any
+        # watcher of the session whose trace_id they carry (enable_
+        # metrics above already turned tracing on for the bridge)
+        from ..obs.tracer import get_tracer
+        get_tracer().subscribe_once(self._span_feed)
         _CURRENT = self
         httpd.register_routes("/v1/", self._handle)
         prev = httpd.get_server()
@@ -209,11 +221,16 @@ class Server:
             if sid in gcd:
                 self._gc_files(sid)       # finish an interrupted GC
                 continue
+            from ..obs.context import new_trace_id
             sess = Session(sid=sid, tenant=r.get("tenant", "default"),
                            payload=r.get("payload", ""),
                            fmt=r.get("fmt", "oink"),
                            submitted_utc=r.get("utc", ""),
-                           priority=int(r.get("priority", 0)))
+                           priority=int(r.get("priority", 0)),
+                           # the replayed session keeps its original
+                           # trace_id (pre-trace journals get a fresh
+                           # one) so the pre-crash artifacts still link
+                           trace_id=r.get("trace") or new_trace_id())
             if sid in done:
                 sess.state = done[sid]
                 try:    # TTL ages from the durable result's mtime
@@ -227,6 +244,8 @@ class Server:
             with self._lock:
                 self.sessions[sid] = sess
                 self._order.append(sid)
+            with self._watch_lock:
+                self._trace_sids[sess.trace_id] = sid
 
     def drain(self) -> None:
         self._draining = True
@@ -242,6 +261,11 @@ class Server:
             t.join(timeout=timeout)
         self._workers = []
         from ..obs import httpd
+        from ..obs.tracer import get_tracer
+        try:
+            get_tracer().unsubscribe(self._span_feed)
+        except Exception:
+            pass
         httpd.unregister_routes("/v1/")
         if _CURRENT is self:
             _CURRENT = None
@@ -295,20 +319,24 @@ class Server:
                     {"Retry-After": self.retry_after()}
             self._seq += 1
             sid = f"s{self._seq:06d}"
+            from ..obs.context import new_trace_id
             sess = Session(
                 sid=sid, tenant=tenant, payload=payload, fmt=fmt,
-                priority=priority,
+                priority=priority, trace_id=new_trace_id(),
                 submitted_utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()))
             # the journal record lands BEFORE the queue sees the
             # session (and before the client's 202): a crash after
             # this line replays the session; a crash before it means
             # the client never heard "accepted" — either way the
-            # journal and the promise agree
+            # journal and the promise agree.  The trace_id rides the
+            # record so a REPLAYED session keeps the id the original
+            # 202's artifacts already carry
             self._journal.append(
                 {"kind": "serve_submit", "sid": sid, "tenant": tenant,
                  "fmt": fmt, "payload": payload, "seq": self._seq,
-                 "priority": priority, "utc": sess.submitted_utc})
+                 "priority": priority, "utc": sess.submitted_utc,
+                 "trace": sess.trace_id})
             if not self.queue.offer(sess, force=True,
                                     priority=priority):
                 # capacity is held by the submit lock, so the only way
@@ -323,8 +351,11 @@ class Server:
             with self._lock:
                 self.sessions[sid] = sess
                 self._order.append(sid)
+            with self._watch_lock:
+                self._trace_sids[sess.trace_id] = sid
         self._metric_admission("accepted", tenant)
-        return 202, {"id": sid, "state": QUEUED, "tenant": tenant}, None
+        return 202, {"id": sid, "state": QUEUED, "tenant": tenant,
+                     "trace_id": sess.trace_id}, None
 
     def retry_after(self) -> int:
         """Honest backpressure: the queue's expected drain time under
@@ -388,6 +419,8 @@ class Server:
                 except ValueError:
                     pass
                 self.gc_count += 1
+            with self._watch_lock:
+                self._trace_sids.pop(sess.trace_id, None)
             n += 1
             try:
                 from ..obs.metrics import get_registry
@@ -417,6 +450,10 @@ class Server:
                 continue
             with self._lock:
                 self._active += 1
+            self._push_event(sess.sid,
+                             {"event": "status", "id": sess.sid,
+                              "state": RUNNING,
+                              "trace_id": sess.trace_id})
             try:
                 result = run_session(self, sess)
             except Exception as e:    # run_session already shields; belt
@@ -443,10 +480,19 @@ class Server:
             try:
                 self._journal.append({"kind": "serve_done",
                                       "sid": sess.sid,
-                                      "status": sess.state})
+                                      "status": sess.state,
+                                      "trace": sess.trace_id})
             except (ValueError, OSError, AttributeError):
                 pass
             self._metric_session(sess)
+            # watchers see the profile BEFORE the terminal status —
+            # the terminal status is the stream's end-of-feed marker
+            acct = sess.account
+            if acct is not None:
+                self._push_event(sess.sid, {"event": "profile",
+                                            "profile": acct.profile()})
+            self._push_event(sess.sid,
+                             {"event": "status", **sess.summary()})
 
     def _metric_session(self, sess: Session) -> None:
         try:
@@ -475,6 +521,129 @@ class Server:
             return 1
         from ..parallel.mesh import mesh_axis_size
         return mesh_axis_size(self.comm)
+
+    # -- request-scoped observability (obs/context.py) ---------------------
+    def _span_feed(self, ev: dict) -> None:
+        """Tracer sink: a finished TOP-LEVEL span whose trace_id maps
+        to a watched session becomes one event on that session's
+        stream.  Must never raise (the tracer drops raising sinks) and
+        must stay cheap — it runs on every span emission process-wide."""
+        try:
+            tid = ev.get("trace")
+            if not tid or ev.get("parent"):
+                return
+            with self._watch_lock:
+                sid = self._trace_sids.get(tid)
+                if sid is None or sid not in self._watch:
+                    return
+            self._push_event(sid, {
+                "event": "span", "name": ev.get("name"),
+                "cat": ev.get("cat"),
+                "dur_ms": round(float(ev.get("dur", 0.0)) / 1000.0, 3),
+                "args": ev.get("args") or {}})
+        except Exception:
+            pass
+
+    def _push_event(self, sid: str, item: dict) -> None:
+        with self._watch_lock:
+            qs = list(self._watch.get(sid, ()))
+        for q in qs:
+            try:
+                q.put_nowait(item)
+            except _queue.Full:
+                pass    # a stalled watcher drops events, never blocks
+                #         the worker (the stream is telemetry, not a
+                #         durable log — the result record is)
+
+    def _events_stream(self, sid: str, timeout: float = 600.0):
+        """Generator behind ``GET /v1/jobs/<id>/events``: one JSON line
+        per event (status transitions, top-level spans, the final cost
+        profile), pushed as they happen — the no-polling exposure.  The
+        subscription attaches BEFORE the state snapshot is read, so a
+        transition in the gap arrives on the queue instead of being
+        missed; ends at terminal state, daemon stop, or the timeout."""
+        import json as _json
+
+        from ..obs.sinks import _jsonable
+
+        def line(obj) -> str:
+            return _json.dumps(obj, default=_jsonable) + "\n"
+
+        q: _queue.Queue = _queue.Queue(maxsize=512)
+        with self._watch_lock:
+            self._watch.setdefault(sid, []).append(q)
+        try:
+            with self._lock:
+                sess = self.sessions.get(sid)
+            if sess is None:
+                yield line({"event": "error",
+                            "error": f"no session {sid!r}"})
+                return
+            if sess.state in (DONE, FAILED):
+                # already finished: replay the durable profile, THEN
+                # the terminal status — same order as the live path
+                # (worker pushes profile before the final status), so
+                # a client that stops at the terminal marker still got
+                # the whole story
+                code, prof = self.profile(sid)
+                if code == 200 and prof.get("profile"):
+                    yield line({"event": "profile",
+                                "profile": prof["profile"]})
+                yield line({"event": "status", **sess.summary()})
+                return
+            yield line({"event": "status", **sess.summary()})
+            deadline = time.monotonic() + timeout
+            last_beat = time.monotonic()
+            while time.monotonic() < deadline \
+                    and not self._stopped.is_set():
+                try:
+                    item = q.get(timeout=0.25)
+                except _queue.Empty:
+                    if time.monotonic() - last_beat >= 15.0:
+                        last_beat = time.monotonic()
+                        yield line({"event": "tick"})
+                    continue
+                yield line(item)
+                if item.get("event") == "status" and \
+                        item.get("state") in (DONE, FAILED):
+                    return
+        finally:
+            with self._watch_lock:
+                qs = self._watch.get(sid)
+                if qs is not None and q in qs:
+                    qs.remove(q)
+                    if not qs:
+                        del self._watch[sid]
+
+    def profile(self, sid: str) -> tuple:
+        """→ (code, dict): the per-request cost profile.  RUNNING
+        sessions serve the LIVE account snapshot (partial, marked
+        ``live``); terminal sessions serve the durable one from the
+        result record; queued sessions 202 like /result."""
+        with self._lock:
+            sess = self.sessions.get(sid)
+        if sess is None:
+            return 404, {"error": f"no session {sid!r}"}
+        if sess.state == QUEUED:
+            return 202, sess.summary()
+        if sess.state == RUNNING:
+            acct = sess.account
+            if acct is None:        # racing the worker's first line
+                return 202, sess.summary()
+            return 200, {"id": sid, "trace_id": sess.trace_id,
+                         "live": True, "profile": acct.profile()}
+        import json
+        try:
+            with open(self.result_path(sid)) as f:
+                res = json.load(f)
+            prof = (res.get("meta") or {}).get("profile")
+            if prof:
+                return 200, {"id": sid, "trace_id": sess.trace_id,
+                             "live": False, "profile": prof}
+        except (OSError, ValueError):
+            pass
+        return 200, {**sess.summary(),
+                     "error": "profile unavailable"}
 
     # -- reads -------------------------------------------------------------
     def status(self, sid: str) -> Optional[dict]:
@@ -552,6 +721,30 @@ class Server:
                 and rest[2] == "result":
             code, out = self.result(rest[1])
             return code, out, "application/json", None
+        if method == "GET" and len(rest) == 3 and rest[0] == "jobs" \
+                and rest[2] == "profile":
+            code, out = self.profile(rest[1])
+            return code, out, "application/json", None
+        if method == "GET" and len(rest) == 3 and rest[0] == "jobs" \
+                and rest[2] == "events":
+            with self._lock:
+                known = rest[1] in self.sessions
+            if not known:
+                return 404, {"error": f"no session {rest[1]!r}"}, \
+                    "application/json", None
+            return 200, self._events_stream(rest[1]), \
+                "application/x-ndjson", None
+        if method == "GET" and rest == ["slo"]:
+            from ..obs import slo as _slo
+            eng = _slo.get_engine()
+            if eng is None:
+                return 200, {"objectives": [], "burn": {},
+                             "firing": [], "alerts": []}, \
+                    "application/json", None
+            # force: an explicit operator ask must never serve a burn
+            # snapshot the scrape-path rate limiter left stale
+            eng.tick(force=True)
+            return 200, eng.snapshot(), "application/json", None
         if method == "GET" and rest == ["stats"]:
             return 200, self.stats(), "application/json", None
         if method == "POST" and rest == ["drain"]:

@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..core.runtime import MRError, global_counters, page_account_scope
+from ..core.runtime import MRError, page_account_scope
 
 QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
 
@@ -86,6 +86,9 @@ class Session:
     priority: int = 0             # admission priority (higher first)
     resharded: bool = False       # resumed onto a different mesh width
     finished_ts: Optional[float] = None   # TTL GC clock (epoch seconds)
+    trace_id: str = ""            # request trace context (obs/context)
+    account: Optional[object] = field(default=None, repr=False,
+                                      compare=False)   # live profile
 
     def summary(self) -> dict:
         return {"id": self.sid, "tenant": self.tenant,
@@ -93,7 +96,8 @@ class Session:
                 "submitted_utc": self.submitted_utc,
                 "wall_s": self.wall_s, "error": self.error,
                 "resumed": self.resumed, "priority": self.priority,
-                "resharded": self.resharded}
+                "resharded": self.resharded,
+                "trace_id": self.trace_id}
 
 
 def normalize_payload(body: dict) -> str:
@@ -169,11 +173,19 @@ def atomic_write_json(path: str, obj: dict) -> None:
 def run_session(server, sess: Session) -> dict:
     """Execute one session on a worker thread; returns (and durably
     writes) the result record.  Never raises — a failing script is a
-    FAILED session, not a dead worker."""
+    FAILED session, not a dead worker.
+
+    The whole run executes under the session's request trace context
+    (obs/context.py): every span, journal record, quarantine record and
+    counter bump — including those from the exec/ prefetch producer,
+    the background spill writer, and the shared ingest pool — carries
+    the session's trace_id and charges its :class:`RequestAccount`, so
+    the ``meta`` deltas are EXACT under concurrency, not
+    "exact only when idle"."""
     from ..ft.journal import Journal, resume_into
+    from ..obs import context as obs_context
     from ..oink.objects import ObjectManager
     from ..oink.script import OinkScript
-    from ..plan.cache import cache_stats, stats_delta
 
     sdir = server.session_dir(sess.sid)
     outdir = os.path.join(sdir, "out")
@@ -210,14 +222,18 @@ def run_session(server, sess: Session) -> dict:
         env_j.close()
 
     acct = server.budgets.account(sess.tenant)
+    if not sess.trace_id:
+        sess.trace_id = obs_context.new_trace_id()
+    req = obs_context.RequestAccount(trace_id=sess.trace_id,
+                                     tenant=sess.tenant,
+                                     label=f"serve:{sess.sid}")
+    sess.account = req          # the /v1/jobs/<id>/profile live view
     sess.state = RUNNING
     sess.resumed = _resumable(sdir)
-    cache_before = cache_stats()
-    nd0 = global_counters().snapshot()["ndispatch"]
     t0 = time.perf_counter()
     error: Optional[str] = None
     try:
-        with page_account_scope(acct):
+        with page_account_scope(acct), obs_context.use(req):
             if sess.resumed:
                 # degraded-mode recovery: the replay runs on WHATEVER
                 # mesh this daemon instance carries; resume_into flags
@@ -245,8 +261,9 @@ def run_session(server, sess: Session) -> dict:
     finally:
         # sessions are one-shot: release every frame the namespace
         # still holds (inside the account scope callers of free() run
-        # on this thread, so the tenant gauge deflates too)
-        with page_account_scope(acct):
+        # on this thread, so the tenant gauge deflates too — and inside
+        # the request context, so the release bills THIS session)
+        with page_account_scope(acct), obs_context.use(req):
             try:
                 cur = script.obj
                 cur.cleanup()
@@ -259,24 +276,30 @@ def run_session(server, sess: Session) -> dict:
     sess.wall_s = round(wall, 4)
     sess.error = error
     status = FAILED if error else DONE
+    # the meta deltas come from the session's OWN RequestAccount — fed
+    # from the same funnels as the process-global counters, scoped to
+    # this request's context — so they are exact with any number of
+    # concurrent sessions (the two-session regression test's contract;
+    # doc/serve.md)
+    profile = req.profile()
+    profile["wall_s"] = sess.wall_s
+    plan_delta = {c: dict(v) for c, v in profile["plan_cache"].items()}
+    plan_delta.setdefault("plan", {"hits": 0, "misses": 0})
     result = {
         "id": sess.sid, "tenant": sess.tenant, "status": status,
         "error": error,
         "output": screen.getvalue(),
         "files": _collect_files(outdir),
         "mrs": mrs,
-        # the deltas are over PROCESS-global counters/caches: exact when
-        # this was the only session executing in the window (1 worker,
-        # or an idle daemon — how bench --serve and the acceptance test
-        # read them); with concurrent sessions they include the
-        # neighbors' traffic (doc/serve.md)
         "meta": {
             "wall_s": sess.wall_s,
+            "trace_id": sess.trace_id,
             "resumed": sess.resumed,
             "resharded": sess.resharded,
-            "dispatches": global_counters().snapshot()["ndispatch"] - nd0,
-            "plan_cache": stats_delta(cache_before),
+            "dispatches": profile["dispatches"],
+            "plan_cache": plan_delta,
             "pages": acct.snapshot(),
+            "profile": profile,
         },
     }
     # the durable result lands BEFORE the state flips: a client polling

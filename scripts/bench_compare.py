@@ -68,6 +68,10 @@ ADVISORY_METRICS = (
     # verify budget) are asserted by tests/test_elastic.py
     ("elastic_reshard_sec", -1),
     ("elastic_verify_overhead_pct", -1),
+    # trace-context armed-vs-disarmed delta (bench.py detail.profile_ab)
+    # — advisory: a micro-cycle's wall is noisy at this scale; the
+    # hard invariants live in tests/test_context.py
+    ("profile_overhead_pct", -1),
 )
 
 DEFAULT_WINDOW = 3
@@ -134,6 +138,9 @@ def record_metrics(rec: dict) -> Optional[dict]:
         pm = (sa.get("warm") or {}).get("plan_misses")
         if pm is not None:
             m["serve_warm_plan_misses"] = pm
+    pab = det.get("profile_ab") or {}
+    if not pab.get("error") and pab.get("overhead_pct") is not None:
+        m["profile_overhead_pct"] = pab["overhead_pct"]
     el = det.get("elastic") or {}
     if not el.get("error"):
         walls = [v for k, v in el.items()

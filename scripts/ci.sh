@@ -45,6 +45,17 @@ run_serve_subset_quick() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_context_subset() {
+  echo "== trace-context / cost-profile / SLO subset (fast) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_context.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+check_metrics_doc() {
+  echo "== metric catalog lint (code vs doc/observability.md) =="
+  python scripts/check_metrics_doc.py
+}
+
 run_elastic_subset_quick() {
   echo "== elastic subset (fast): reshard unit + manifest round-trip =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
@@ -73,11 +84,13 @@ bench_compare_advisory() {
 }
 
 if [ "${1:-}" = "quick" ]; then
+  check_metrics_doc
   run_plan_subset
   run_metrics_subset
   run_exec_subset
   run_ft_subset
   run_serve_subset_quick
+  run_context_subset
   run_elastic_subset_quick
   bench_compare_advisory
   exit 0
@@ -93,10 +106,12 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
 [ "$rc" -eq 0 ] || exit "$rc"
 
+check_metrics_doc
 run_plan_subset
 run_metrics_subset
 run_exec_subset
 run_ft_subset
 run_serve_subset_full
+run_context_subset
 run_elastic_subset_full
 bench_compare_advisory

@@ -5,6 +5,9 @@
     mrctl.py [...] submit - --tenant T          # script from stdin
     mrctl.py [...] status [SID]                 # one session / all
     mrctl.py [...] result SID [--wait SECS]
+    mrctl.py [...] profile SID                  # per-request cost profile
+    mrctl.py [...] watch SID [--timeout SECS]   # stream /events (no poll)
+    mrctl.py [...] slo
     mrctl.py [...] stats
     mrctl.py [...] drain
     mrctl.py [...] shutdown
@@ -14,7 +17,8 @@ Daemon discovery: ``--port`` wins; otherwise ``--state DIR`` (or
 which is how an ephemeral-port (``--port 0``) daemon is addressed.
 Exit codes: 0 ok, 2 usage, 3 daemon unreachable, 4 rejected (429/503 —
 stderr carries Retry-After), 5 session failed, 6 still running at the
---wait deadline.
+--wait/--timeout deadline (`watch` included: a stream that ends before
+the terminal status exits 6).
 """
 
 from __future__ import annotations
@@ -68,6 +72,15 @@ def main(argv=None) -> int:
     rs = sub.add_parser("result")
     rs.add_argument("sid")
     rs.add_argument("--wait", type=float, default=0.0, metavar="SECS")
+    pf = sub.add_parser("profile")
+    pf.add_argument("sid")
+    wt = sub.add_parser("watch")
+    wt.add_argument("sid")
+    wt.add_argument("--timeout", type=float, default=3600.0,
+                    metavar="SECS",
+                    help="give up (exit 6) if the session has not "
+                         "reached a terminal state by then")
+    sub.add_parser("slo")
     sub.add_parser("stats")
     sub.add_parser("drain")
     sub.add_parser("shutdown")
@@ -93,6 +106,51 @@ def main(argv=None) -> int:
                 else c.result(args.sid)
             print(json.dumps(r, indent=2))
             return 5 if r.get("status") == "failed" else 0
+        elif args.cmd == "profile":
+            r = c.profile(args.sid)
+            print(json.dumps(r, indent=2))
+            return 5 if r.get("state") == "failed" and \
+                not r.get("profile") else 0
+        elif args.cmd == "watch":
+            # streamed events, no polling: print each line, exit on the
+            # session's terminal status like `result --wait`.  The
+            # server caps one stream (~10 min), so reconnect until OUR
+            # deadline — and an event already in hand is always
+            # processed, even past the deadline (the deadline is only
+            # checked on heartbeats and reconnects, so a terminal
+            # status arriving late is reported, not discarded)
+            import time as _time
+            deadline = _time.monotonic() + args.timeout
+            last_state = None
+            expired = False
+            while not expired:
+                for ev in c.events(args.sid, timeout=60.0):
+                    kind = ev.get("event")
+                    if kind == "tick":
+                        if _time.monotonic() > deadline:
+                            expired = True
+                            break
+                        continue
+                    if kind == "status" and \
+                            ev.get("state") == last_state:
+                        continue    # a reconnect replayed a known state
+                    print(json.dumps(ev))
+                    if kind == "error":
+                        print(ev.get("error"), file=sys.stderr)
+                        return 3
+                    if kind == "status":
+                        last_state = ev.get("state")
+                        if last_state in ("done", "failed"):
+                            return 5 if last_state == "failed" else 0
+                else:
+                    # server-side stream cap without a terminal status:
+                    # reconnect unless the operator's deadline passed
+                    expired = _time.monotonic() > deadline
+            print(f"session {args.sid} not finished by the --timeout "
+                  f"deadline", file=sys.stderr)
+            return 6
+        elif args.cmd == "slo":
+            print(json.dumps(c.slo(), indent=2))
         elif args.cmd == "stats":
             print(json.dumps(c.stats(), indent=2))
         elif args.cmd == "drain":

@@ -3,6 +3,8 @@
 Usage:
     python scripts/trace_view.py TRACE.jsonl [--chrome OUT.json]
                                              [--cat CAT] [--json]
+    python scripts/trace_view.py TRACE.jsonl --traces
+    python scripts/trace_view.py TRACE.jsonl --trace ID [--json]
     python scripts/trace_view.py --probe PROBE.jsonl [--json]
 
 TRACE.jsonl is what a run writes under MRTPU_TRACE=path (or
@@ -10,6 +12,13 @@ MapReduce(trace=path)).  --chrome additionally writes the
 Perfetto-loadable Chrome trace-event file; --cat filters to one span
 category (mr_op / shuffle / ingest / oink / app / soak); --json prints
 the aggregate as JSON instead of the table.
+
+--traces lists the request trace ids in the file (obs/context.py: a
+serve session, a top-level OINK run, or the process context) with span
+counts and wall time; --trace ID filters to ONE request and prints its
+per-op table, cost roll-up and CRITICAL PATH — the chain of
+longest-child spans under the request's longest top-level span, with
+per-hop self time, i.e. where the request's wall actually went.
 
 --probe summarizes a TPU probe JSONL (scripts/tpu_watch.sh writes one
 event {"ts","phase","rc","latency_s"} per probe/step attempt) into an
@@ -96,6 +105,104 @@ def probe_table(events) -> str:
     return "\n".join(lines)
 
 
+_BYTE_ARGS = ("shuffle_sent_bytes", "shuffle_pad_bytes",
+              "spill_write_bytes", "spill_read_bytes")
+
+
+def trace_index(events) -> dict:
+    """{trace_id: {spans, top_spans, wall_s}} over a span stream."""
+    out = {}
+    for ev in events:
+        tid = ev.get("trace")
+        if not tid:
+            continue
+        row = out.setdefault(tid, {"spans": 0, "top_spans": 0,
+                                   "_t0": None, "_t1": None})
+        row["spans"] += 1
+        if not ev.get("parent"):
+            row["top_spans"] += 1
+        t0 = float(ev.get("ts", 0.0))
+        t1 = t0 + float(ev.get("dur", 0.0))
+        row["_t0"] = t0 if row["_t0"] is None else min(row["_t0"], t0)
+        row["_t1"] = t1 if row["_t1"] is None else max(row["_t1"], t1)
+    for row in out.values():
+        row["wall_s"] = round(((row.pop("_t1") or 0.0)
+                               - (row.pop("_t0") or 0.0)) / 1e6, 6)
+    return out
+
+
+def critical_path(events) -> list:
+    """The longest-child chain under the longest top-level span of ONE
+    request's events: [{name, dur_s, self_s, args}] root-first.
+    ``self_s`` = dur minus direct children — a hop with high self time
+    is where the wall went; a hop whose children cover it is just a
+    container."""
+    children = {}
+    for ev in events:
+        children.setdefault(ev.get("parent") or 0, []).append(ev)
+    tops = children.get(0, [])
+    if not tops:
+        return []
+    path = []
+    node = max(tops, key=lambda e: float(e.get("dur", 0.0)))
+    while node is not None:
+        kids = children.get(node.get("id"), [])
+        dur = float(node.get("dur", 0.0)) / 1e6
+        covered = sum(float(k.get("dur", 0.0)) for k in kids) / 1e6
+        path.append({"name": node.get("name", "?"),
+                     "cat": node.get("cat", "?"),
+                     "dur_s": round(dur, 6),
+                     "self_s": round(max(0.0, dur - covered), 6),
+                     "args": node.get("args") or {}})
+        node = max(kids, key=lambda e: float(e.get("dur", 0.0))) \
+            if kids else None
+    return path
+
+
+def trace_profile(events, tid: str) -> dict:
+    """One request's offline cost profile: roll-up + per-op aggregate +
+    critical path (the file-based twin of ``GET /v1/jobs/<id>/profile``)."""
+    from gpu_mapreduce_tpu.obs import aggregate_ops
+    mine = [e for e in events if e.get("trace") == tid]
+    rollup = {k: 0 for k in _BYTE_ARGS}
+    dispatches = 0
+    for ev in mine:
+        args = ev.get("args") or {}
+        # roll up from TOP-LEVEL spans only: a child's delta is already
+        # inside its parent's (the tracer snapshots per span)
+        if not ev.get("parent"):
+            for k in _BYTE_ARGS:
+                rollup[k] += int(args.get(k, 0) or 0)
+            dispatches += int(args.get("dispatches", 0) or 0)
+    idx = trace_index(mine).get(tid, {})
+    return {"trace_id": tid,
+            "spans": len(mine),
+            "wall_s": idx.get("wall_s", 0.0),
+            "dispatches": dispatches,
+            **rollup,
+            "ops": aggregate_ops(mine),
+            "critical_path": critical_path(mine)}
+
+
+def trace_report(events, tid: str) -> str:
+    from gpu_mapreduce_tpu.obs import per_op_table
+    prof = trace_profile(events, tid)
+    mine = [e for e in events if e.get("trace") == tid]
+    lines = [f"trace {tid}: {prof['spans']} spans, "
+             f"{prof['wall_s']:.4f}s wall, "
+             f"{prof['dispatches']} dispatches, "
+             f"{prof['shuffle_sent_bytes'] / (1 << 20):.3g} Mb sent "
+             f"(+{prof['shuffle_pad_bytes'] / (1 << 20):.3g} Mb pad), "
+             f"{prof['spill_write_bytes'] / (1 << 20):.3g} Mb spilled",
+             "", per_op_table(mine), "", "critical path:"]
+    for i, hop in enumerate(prof["critical_path"]):
+        lines.append(f"  {'  ' * i}{hop['name']}  "
+                     f"{hop['dur_s']:.4f}s (self {hop['self_s']:.4f}s)")
+    if not prof["critical_path"]:
+        lines.append("  (no spans for this trace id)")
+    return "\n".join(lines)
+
+
 def main(argv) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__.strip())
@@ -125,18 +232,25 @@ def main(argv) -> int:
     path = argv[0]
     chrome = None
     cat = None
+    trace = None
+    list_traces = False
     as_json = False
     i = 1
     while i < len(argv):
-        if argv[i] in ("--chrome", "--cat"):
+        if argv[i] in ("--chrome", "--cat", "--trace"):
             if i + 1 >= len(argv):
                 print(f"{argv[i]} needs a value", file=sys.stderr)
                 return 1
             if argv[i] == "--chrome":
                 chrome = argv[i + 1]
+            elif argv[i] == "--trace":
+                trace = argv[i + 1]
             else:
                 cat = argv[i + 1]
             i += 2
+        elif argv[i] == "--traces":
+            list_traces = True
+            i += 1
         elif argv[i] == "--json":
             as_json = True
             i += 1
@@ -148,6 +262,24 @@ def main(argv) -> int:
     events = read_jsonl(path)
     if cat:
         events = [e for e in events if e.get("cat") == cat]
+    if list_traces:
+        idx = trace_index(events)
+        if as_json:
+            print(json.dumps(idx, indent=2))
+        else:
+            for tid in sorted(idx, key=lambda t: -idx[t]["wall_s"]):
+                r = idx[tid]
+                print(f"{tid}  {r['spans']:6d} spans  "
+                      f"{r['top_spans']:4d} top  {r['wall_s']:.4f}s")
+            if not idx:
+                print("(no trace ids in this file)")
+        return 0
+    if trace is not None:
+        if as_json:
+            print(json.dumps(trace_profile(events, trace), indent=2))
+        else:
+            print(trace_report(events, trace))
+        return 0
     if as_json:
         print(json.dumps(aggregate_ops(events), indent=2))
     else:

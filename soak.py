@@ -544,77 +544,111 @@ def main():
         import tempfile
         import threading
 
+        from gpu_mapreduce_tpu.obs import slo as obs_slo
         from gpu_mapreduce_tpu.serve import Server, ServeClient, ServeError
         nclients = env_knob("SOAK_SERVE_CLIENTS", int, 4)
         nreqs = env_knob("SOAK_SERVE_REQS", int, 8)
-        with tempfile.TemporaryDirectory() as tmp:
-            corpus = os.path.join(tmp, "corpus.txt")
-            rng4 = np.random.default_rng(23)
-            with open(corpus, "w") as f:
-                for w in rng4.integers(0, 2048, 60000):
-                    f.write(f"w{w:04d} ")
-            script = (f"variable files index {corpus}\n"
-                      f"set fuse 1\n"
-                      f"wordfreq 5 -i v_files\n")
-            srv = Server(port=0, workers=min(4, max(1, nclients)),
-                         queue_cap=max(8, nclients * 2),
-                         state_dir=os.path.join(tmp, "state"))
-            port = srv.start()
-            lat: list = []
-            nrejects = [0]
-            client_errors: list = []
-            lock = threading.Lock()
+        # arm the SLO engine with soak-scale windows: the published
+        # serve_slo_burn row is the burn ratio the engine computes from
+        # the very session metrics the daemon feeds (doc/observability.md)
+        slo_p99_ms = env_knob("SOAK_SERVE_SLO_P99_MS", float, 30000.0)
+        eng = obs_slo.configure(obs_slo.parse_slo(
+            f"tenant=*;p99_ms={slo_p99_ms};err_pct=1;windows=60,300"))
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                corpus = os.path.join(tmp, "corpus.txt")
+                rng4 = np.random.default_rng(23)
+                with open(corpus, "w") as f:
+                    for w in rng4.integers(0, 2048, 60000):
+                        f.write(f"w{w:04d} ")
+                script = (f"variable files index {corpus}\n"
+                          f"set fuse 1\n"
+                          f"wordfreq 5 -i v_files\n")
+                srv = Server(port=0, workers=min(4, max(1, nclients)),
+                             queue_cap=max(8, nclients * 2),
+                             state_dir=os.path.join(tmp, "state"))
+                port = srv.start()
+                lat: list = []
+                nrejects = [0]
+                client_errors: list = []
+                profiles: list = []
+                lock = threading.Lock()
 
-            def one_client(ci: int):
-                try:
-                    c = ServeClient.local(port)
-                    done = 0
-                    while done < nreqs:
-                        t0 = time.perf_counter()
-                        try:
-                            r = c.submit(script=script, tenant=f"c{ci}")
-                        except ServeError as e:
-                            if e.code != 429:
-                                raise
+                def one_client(ci: int):
+                    try:
+                        c = ServeClient.local(port)
+                        done = 0
+                        while done < nreqs:
+                            t0 = time.perf_counter()
+                            try:
+                                r = c.submit(script=script, tenant=f"c{ci}")
+                            except ServeError as e:
+                                if e.code != 429:
+                                    raise
+                                with lock:
+                                    nrejects[0] += 1
+                                time.sleep(min(2.0, e.retry_after or 1))
+                                continue
+                            res = c.wait(r["id"], timeout=300)
+                            if res.get("status") != "done":
+                                raise RuntimeError(res.get("error"))
+                            prof = (res.get("meta") or {}).get("profile")
                             with lock:
-                                nrejects[0] += 1
-                            time.sleep(min(2.0, e.retry_after or 1))
-                            continue
-                        res = c.wait(r["id"], timeout=300)
-                        if res.get("status") != "done":
-                            raise RuntimeError(res.get("error"))
+                                lat.append(time.perf_counter() - t0)
+                                if prof:
+                                    profiles.append(prof)
+                            done += 1
+                    except Exception as e:   # noqa: BLE001 — re-raised below
                         with lock:
-                            lat.append(time.perf_counter() - t0)
-                        done += 1
-                except Exception as e:   # noqa: BLE001 — re-raised below
-                    with lock:
-                        client_errors.append(f"client {ci}: {e!r}")
+                            client_errors.append(f"client {ci}: {e!r}")
 
-            t0 = time.perf_counter()
-            threads = [threading.Thread(target=one_client, args=(ci,))
-                       for ci in range(nclients)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            wall = time.perf_counter() - t0
-            srv.shutdown()
-            if client_errors:
-                # a dead client thread must fail the workload, not
-                # silently inflate req/s computed from the full total
-                raise RuntimeError("; ".join(client_errors[:3]))
-            total = nclients * nreqs
-            published["serve_requests_per_sec"] = round(total / wall, 2)
-            published["serve_p50_latency_s"] = round(
-                float(np.percentile(lat, 50)), 4)
-            published["serve_p99_latency_s"] = round(
-                float(np.percentile(lat, 99)), 4)
-            published["serve_admission_rejects"] = nrejects[0]
-            print(f"serve: {nclients} clients x {nreqs} reqs in "
-                  f"{wall:.2f}s -> {total / wall:,.1f} req/s, p50 "
-                  f"{np.percentile(lat, 50):.3f}s, p99 "
-                  f"{np.percentile(lat, 99):.3f}s, "
-                  f"{nrejects[0]} 429s retried")
+                t0 = time.perf_counter()
+                threads = [threading.Thread(target=one_client, args=(ci,))
+                           for ci in range(nclients)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                # evaluate the SLO burn BEFORE shutdown drops the daemon's
+                # collector: one forced tick over the finished sessions
+                burn = eng.tick(force=True)
+                srv.shutdown()
+                if client_errors:
+                    # a dead client thread must fail the workload, not
+                    # silently inflate req/s computed from the full total
+                    raise RuntimeError("; ".join(client_errors[:3]))
+                total = nclients * nreqs
+                published["serve_requests_per_sec"] = round(total / wall, 2)
+                published["serve_p50_latency_s"] = round(
+                    float(np.percentile(lat, 50)), 4)
+                published["serve_p99_latency_s"] = round(
+                    float(np.percentile(lat, 99)), 4)
+                published["serve_admission_rejects"] = nrejects[0]
+                published["serve_slo_burn"] = round(max(
+                    (b for per in burn.values() for b in per.values()),
+                    default=0.0), 4)
+                if profiles:
+                    med = lambda key: round(float(np.median(  # noqa: E731
+                        [key(p) for p in profiles])), 2)
+                    published["serve_profile_median_dispatches"] = \
+                        med(lambda p: p.get("dispatches", 0))
+                    published["serve_profile_median_exchange_kb"] = \
+                        med(lambda p: p.get("exchange", {})
+                            .get("sent_bytes", 0) / 1024.0)
+                    published["serve_profile_median_spill_kb"] = \
+                        med(lambda p: p.get("spill", {})
+                            .get("write_bytes", 0) / 1024.0)
+                print(f"serve: {nclients} clients x {nreqs} reqs in "
+                      f"{wall:.2f}s -> {total / wall:,.1f} req/s, p50 "
+                      f"{np.percentile(lat, 50):.3f}s, p99 "
+                      f"{np.percentile(lat, 99):.3f}s, "
+                      f"{nrejects[0]} 429s retried, slo burn "
+                      f"{published['serve_slo_burn']}")
+        finally:
+            # don't leak the soak windows into MRTPU_SLO state,
+            # even when a client thread failed the workload
+            obs_slo.reset()
 
     workloads = [("degree", do_degree), ("cc_find", do_cc),
                  ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),

@@ -767,3 +767,177 @@ def test_degraded_restart_resumes_on_available_mesh(tmp_path):
     assert res["meta"]["resharded"] is True
     assert {k: v["sha256"] for k, v in res["files"].items()} == \
         {k: v["sha256"] for k, v in golden["files"].items()}
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing + exact per-request attribution (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_concurrent_sessions_meta_deltas_exact(tmp_path):
+    """THE regression for the retired exact-only-when-idle caveat: two
+    sessions run CONCURRENTLY (workers=2) — a spill-heavy one and a
+    light one — and each result's meta/profile shows exactly its own
+    traffic.  Before the RequestAccount scope, the light session's
+    deltas bracketed process-global counters and inhaled its
+    neighbor's spill bytes."""
+    budgets = TenantBudgets(pages=1, memsize=1)    # force A to spill
+    srv = Server(port=0, workers=2, queue_cap=8,
+                 state_dir=str(tmp_path / "state"), budgets=budgets)
+    srv.start()
+    try:
+        c = client(srv)
+        big = write_corpus(tmp_path / "big.txt",
+                           [f"w{i:04d}" for i in range(200)], 2000)
+        small = write_corpus(tmp_path / "small.txt", ["tiny", "data"],
+                             10)
+        ra = c.submit(script=wf_script(big, top=2), tenant="heavy")
+        rb = c.submit(script=wf_script(small, top=2), tenant="light")
+        res_a = c.wait(ra["id"], timeout=240)
+        res_b = c.wait(rb["id"], timeout=240)
+        assert res_a["status"] == "done" and res_b["status"] == "done"
+        prof_a = res_a["meta"]["profile"]
+        prof_b = res_b["meta"]["profile"]
+        # distinct request identities, stamped everywhere
+        assert res_a["meta"]["trace_id"] != res_b["meta"]["trace_id"]
+        assert prof_a["trace_id"] == res_a["meta"]["trace_id"]
+        # A really spilled; B's account saw NONE of it, even though
+        # both ran on one process's shared global counters
+        assert prof_a["spill"]["write_bytes"] > 0
+        assert prof_b["spill"]["write_bytes"] == 0
+        assert prof_b["spill"]["read_bytes"] == 0
+        # stage tables are per-request too
+        assert "oink.wordfreq" in prof_a["stages"]
+        assert "oink.wordfreq" in prof_b["stages"]
+    finally:
+        srv.shutdown()
+
+
+def test_session_trace_id_links_every_artifact(server, tmp_path):
+    """One request, one id: the 202, result meta, /profile, the
+    session journal records, and the session's spans on any trace sink
+    (the serve-worker half of the propagation goldens)."""
+    import gpu_mapreduce_tpu.obs as obs
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    trace_path = str(tmp_path / "serve_trace.jsonl")
+    obs.get_tracer().enable(jsonl=trace_path)
+    c = client(server)
+    corpus = write_corpus(tmp_path / "w.txt", ["to", "be", "or"], 40)
+    r = c.submit(script=wf_script(corpus), tenant="acme")
+    tid = r["trace_id"]
+    assert tid
+    res = c.wait(r["id"])
+    assert res["status"] == "done"
+    assert res["meta"]["trace_id"] == tid
+    assert res["meta"]["profile"]["trace_id"] == tid
+    assert c.status(r["id"])["trace_id"] == tid
+    # /profile serves the same id (durable once finished)
+    prof = c.profile(r["id"])
+    assert prof["trace_id"] == tid and prof["live"] is False
+    assert prof["profile"]["stages"].get("oink.wordfreq")
+    # session journal records are stamped
+    recs = read_journal(os.path.join(server.state_dir, "sessions",
+                                     r["id"]))
+    assert recs and all(rec.get("trace") == tid for rec in recs)
+    # the worker's spans carry it on the shared JSONL sink
+    mine = [e for e in obs.read_jsonl(trace_path)
+            if e.get("trace") == tid]
+    assert any(e["name"] == "oink.wordfreq" for e in mine)
+    # the serve journal's submit record carries it (replay keeps ids)
+    srecs = read_journal(server.state_dir)
+    sub = [x for x in srecs if x.get("kind") == "serve_submit"
+           and x.get("sid") == r["id"]]
+    assert sub and sub[0]["trace"] == tid
+
+
+def test_events_stream_live_no_polling(server, tmp_path):
+    """/v1/jobs/<id>/events: ONE streamed request observes the running
+    transition, at least one top-level span, the final profile, and
+    the terminal status — no client polling."""
+    c = client(server)
+    blocker = write_corpus(tmp_path / "blk.txt",
+                           [f"w{i:03d}" for i in range(100)], 1500)
+    corpus = write_corpus(tmp_path / "w.txt", ["to", "be", "or"], 40)
+    # saturate both workers so the watched session stays queued until
+    # the stream is attached
+    rb1 = c.submit(script=wf_script(blocker, top=2))
+    rb2 = c.submit(script=wf_script(blocker, top=2))
+    r = c.submit(script=wf_script(corpus))
+    seen = list(c.events(r["id"], timeout=120))
+    kinds = [e["event"] for e in seen]
+    states = [e.get("state") for e in seen if e["event"] == "status"]
+    assert states[0] in ("queued", "running", "done")
+    assert states[-1] == "done"                    # stream ends terminal
+    if states[0] == "queued":                      # attached in time:
+        assert "running" in states                # saw the transition
+    assert any(e["event"] == "profile" for e in seen)
+    prof = [e for e in seen if e["event"] == "profile"][-1]["profile"]
+    assert prof["trace_id"] == r["trace_id"]
+    c.wait(rb1["id"], timeout=240)
+    c.wait(rb2["id"], timeout=240)
+    # a finished session's stream replays profile THEN the terminal
+    # status (the live ordering: a client stopping at the terminal
+    # marker has already seen the profile) and ends
+    replay = list(c.events(r["id"], timeout=60))
+    assert [e["event"] for e in replay] == ["profile", "status"]
+    assert replay[-1]["state"] == "done"
+    # unknown session: a clean 404, not a stream
+    with pytest.raises(ServeError) as ei:
+        list(c.events("nope"))
+    assert ei.value.code == 404
+
+
+def test_slo_endpoint_and_burn(server, monkeypatch):
+    import gpu_mapreduce_tpu.obs.slo as obs_slo
+    monkeypatch.setenv("MRTPU_SLO",
+                       "tenant=*;p99_ms=60000;err_pct=1;windows=60,600")
+    obs_slo.reset()                      # re-read the env spec
+    try:
+        c = client(server)
+        # three failing sessions for a fresh tenant → err burn >> 1
+        for _ in range(3):
+            r = c.submit(script="frobnicate\n", tenant="slo-t")
+            assert c.wait(r["id"])["status"] == "failed"
+        out = c.slo()
+        assert out["objectives"], out
+        assert out["burn"]["slo-t"]["60s"] > 1.0
+        assert "slo-t" in out["firing"]
+        # the burn gauge landed in the registry
+        from gpu_mapreduce_tpu.obs.metrics import get_registry
+        samples = get_registry().collect()[
+            "mrtpu_slo_burn_ratio"]["samples"]
+        assert any(s["labels"]["tenant"] == "slo-t" for s in samples)
+    finally:
+        obs_slo.reset()
+
+
+def test_mrctl_profile_watch_slo(server, tmp_path, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mrctl", os.path.join(REPO, "scripts", "mrctl.py"))
+    mrctl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mrctl)
+    c = client(server)
+    corpus = write_corpus(tmp_path / "w.txt", ["to", "be", "or"], 40)
+    r = c.submit(script=wf_script(corpus))
+    c.wait(r["id"])
+    port = ["--port", str(server.port)]
+    assert mrctl.main(port + ["profile", r["id"]]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["trace_id"] == r["trace_id"]
+    assert out["profile"]["dispatches"] >= 0
+    # watch on a finished session: prints the profile and the terminal
+    # status (in that order — the stop-at-terminal client still gets
+    # the profile), exit 0
+    assert mrctl.main(port + ["watch", r["id"]]) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["event"] for ln in lines] == ["profile", "status"]
+    assert lines[-1]["state"] == "done"
+    # slo subcommand round-trips
+    assert mrctl.main(port + ["slo"]) == 0
+    json.loads(capsys.readouterr().out)
+    # failed session → watch exits 5
+    rf = c.submit(script="frobnicate\n")
+    c.wait(rf["id"])
+    assert mrctl.main(port + ["watch", rf["id"]]) == 5
+    capsys.readouterr()
