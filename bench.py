@@ -313,6 +313,8 @@ OVERLAP_MODE = None  # --overlap {0,1,ab} (or BENCH_OVERLAP); None = skip
 SERVE_MODE = False   # --serve (or BENCH_SERVE=1): daemon cold/warm A/B
 ELASTIC_MODE = False  # --elastic (or BENCH_ELASTIC=1): reshard wall +
 #                       MRTPU_VERIFY read-overhead advisory rows
+WIRE_MODE = None   # --wire {0,1,ab} (or BENCH_WIRE): compressed-vs-raw
+#                    shuffle exchange A/B on the shuffle-bound workloads
 GATE = False       # --gate: after the run, regress-check against the
 #                    BENCH_r*.json trailing baseline (scripts/
 #                    bench_compare.py) and exit nonzero on a trip
@@ -589,6 +591,104 @@ def profile_ab_record() -> dict:
             if off > 0 else 0.0}
 
 
+_WIRE_PROBE = r"""
+import json, os, time
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.ops.reduces import count
+from gpu_mapreduce_tpu.parallel import shuffle
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+mesh = make_mesh(8)
+rows = int(os.environ.get("BENCH_WIRE_ROWS", 1 << 19))
+rng = np.random.default_rng(3)
+# zipf-skewed keys in a u32-ish range: the IntCount shape (maximum key
+# cardinality, minimum payload) with RMAT-hub skew — the workload the
+# pad tax and the wire codec both live on
+zkeys = np.minimum(rng.zipf(1.3, rows), 1 << 22).astype(np.uint64)
+ones32 = np.ones(rows, np.uint32)
+
+def intcount_run():
+    mr = MapReduce(mesh)
+    mr.map(1, lambda i, kv, p: kv.add_batch(zkeys, ones32))
+    t0 = time.perf_counter()
+    mr.aggregate(); mr.convert()
+    n = int(mr.reduce(count, batch=True))
+    return n, time.perf_counter() - t0, mr.last_exchange
+
+def scrunch_run():
+    mr = MapReduce(mesh)
+    mr.map(1, lambda i, kv, p: kv.add_batch(zkeys, ones32.astype(np.uint64)))
+    t0 = time.perf_counter()
+    mr.scrunch(2, np.uint64(7))
+    g, n, _ = mr.kmv_stats()
+    return (g, n), time.perf_counter() - t0, mr.last_exchange
+
+mode = os.environ.get("BENCH_WIRE_MODE", "ab")
+out = {"rows": rows, "mode": mode}
+for name, run in (("intcount", intcount_run), ("scrunch", scrunch_run)):
+    rec = {}
+    results = {}
+    for flag in ("0", "1"):
+        if mode != "ab" and mode != flag:
+            continue
+        os.environ["MRTPU_WIRE"] = flag
+        shuffle._SPEC_CACHE.clear()
+        run()                                # warm the compiles
+        res, wall, st = run()                # steady state
+        results[flag] = res
+        total = (st.wire_bytes if st and st.wire_bytes
+                 else (st.sent_bytes + st.pad_bytes) if st else 0)
+        rec["wire" + flag] = {
+            "wall_s": round(wall, 4),
+            "pairs_per_sec": round(rows / wall, 1),
+            "sent_bytes": st.sent_bytes if st else 0,
+            "pad_bytes": st.pad_bytes if st else 0,
+            "wire_bytes": st.wire_bytes if st else 0,
+            "exchanged_bytes": total,
+            "compression_ratio": st.wire_ratio if st else 0.0,
+        }
+    if len(results) == 2:
+        rec["outputs_equal"] = results["0"] == results["1"]
+        b0 = rec["wire0"]["exchanged_bytes"]
+        b1 = rec["wire1"]["exchanged_bytes"]
+        rec["bytes_reduction_pct"] = round((1 - b1 / b0) * 100.0, 2) \
+            if b0 else 0.0
+        w0, w1 = rec["wire0"]["wall_s"], rec["wire1"]["wall_s"]
+        rec["wall_delta_pct"] = round((w1 - w0) / w0 * 100.0, 2) \
+            if w0 else 0.0
+    out[name] = rec
+print(json.dumps(out))
+"""
+
+
+def wire_ab_record(mode: str) -> dict:
+    """``--wire {0,1,ab}``: compressed-vs-raw exchange A/B on the
+    shuffle-bound workloads (zipf-skewed intcount aggregate + scrunch
+    gather) over an 8-way fake mesh in a subprocess (the fake topology
+    must not leak into the headline process).  Records wall, exchange
+    sent/pad/wire bytes and the compression ratio into
+    ``detail.wire_ab`` — the advisory ``wire_*`` rows of
+    scripts/bench_compare.py."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    env["BENCH_WIRE_MODE"] = mode
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    p = subprocess.run([sys.executable, "-c", _WIRE_PROBE],
+                       capture_output=True, text=True, timeout=900,
+                       env=env, cwd=os.path.dirname(
+                           os.path.abspath(__file__)))
+    if p.returncode != 0:
+        raise RuntimeError(f"wire probe failed: {p.stderr[-400:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
 _ELASTIC_PROBE = r"""
 import json, os, sys, time, tempfile
 import numpy as np
@@ -775,6 +875,14 @@ def run_bench(engine, backend_err):
         except Exception:
             detail["elastic"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if WIRE_MODE:
+        # --wire {0,1,ab}: compressed-vs-raw exchange A/B (parallel/
+        # wire.py); failures must not cost the headline metric line
+        try:
+            detail["wire_ab"] = wire_ab_record(WIRE_MODE)
+        except Exception:
+            detail["wire_ab"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     if os.environ.get("BENCH_PROFILE_AB", "1") != "0":
         # trace-context armed-vs-disarmed micro A/B (obs/context.py):
         # cheap (~seconds), recorded on every round so the advisory
@@ -804,7 +912,8 @@ def run_bench(engine, backend_err):
 
 
 def main():
-    global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, ELASTIC_MODE, GATE
+    global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, ELASTIC_MODE, GATE, \
+        WIRE_MODE
     argv = sys.argv[1:]
     GATE = "--gate" in argv or os.environ.get("BENCH_GATE") == "1"
     if "--fuse" in argv:
@@ -822,6 +931,13 @@ def main():
     if OVERLAP_MODE not in (None, "0", "1", "ab"):
         raise SystemExit(
             f"--overlap takes 0, 1 or ab, got {OVERLAP_MODE!r}")
+    if "--wire" in argv:
+        i = argv.index("--wire")
+        WIRE_MODE = argv[i + 1] if i + 1 < len(argv) else "ab"
+    else:
+        WIRE_MODE = os.environ.get("BENCH_WIRE") or None
+    if WIRE_MODE not in (None, "0", "1", "ab"):
+        raise SystemExit(f"--wire takes 0, 1 or ab, got {WIRE_MODE!r}")
     SERVE_MODE = "--serve" in argv or \
         os.environ.get("BENCH_SERVE") == "1"
     ELASTIC_MODE = "--elastic" in argv or \

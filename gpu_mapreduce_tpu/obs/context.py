@@ -87,7 +87,8 @@ class RequestAccount:
     __slots__ = ("trace_id", "tenant", "label", "t0", "_lock",
                  "dispatches", "comm_s",
                  "exchange_count", "exchange_sent", "exchange_pad",
-                 "exchange_rows", "exchange_rounds",
+                 "exchange_rows", "exchange_rounds", "exchange_wire",
+                 "exchange_wire_logical",
                  "spill_write", "spill_read",
                  "mem_in_use", "mem_hi_water",
                  "retries", "plan", "stages")
@@ -106,6 +107,8 @@ class RequestAccount:
         self.exchange_pad = 0
         self.exchange_rows = 0
         self.exchange_rounds = 0
+        self.exchange_wire = 0
+        self.exchange_wire_logical = 0
         self.spill_write = 0
         self.spill_read = 0
         self.mem_in_use = 0
@@ -136,13 +139,23 @@ class RequestAccount:
                 self.mem_hi_water = self.mem_in_use
 
     def note_exchange(self, stats) -> None:
-        """Per-call shuffle telemetry (rows/rounds/calls; the byte
-        volume arrives via :meth:`note_counters` — one source each,
-        never double-counted)."""
+        """Per-call shuffle telemetry (rows/rounds/calls + the wire
+        codec's actual interconnect bytes; the logical byte volume
+        arrives via :meth:`note_counters` — one source each, never
+        double-counted)."""
         with self._lock:
             self.exchange_count += 1
             self.exchange_rows += int(getattr(stats, "rows", 0))
             self.exchange_rounds += int(getattr(stats, "nrounds", 0))
+            wire = int(getattr(stats, "wire_bytes", 0))
+            self.exchange_wire += wire
+            if wire:
+                # the ratio's numerator counts ONLY codec-compressed
+                # exchanges — raw-bypass logical bytes in the request
+                # must not inflate the reported compression
+                self.exchange_wire_logical += (
+                    int(getattr(stats, "sent_bytes", 0))
+                    + int(getattr(stats, "pad_bytes", 0)))
 
     def note_retry(self, site: str, outcome: str) -> None:
         with self._lock:
@@ -205,7 +218,15 @@ class RequestAccount:
                              "sent_bytes": self.exchange_sent,
                              "pad_bytes": self.exchange_pad,
                              "rows": self.exchange_rows,
-                             "rounds": self.exchange_rounds},
+                             "rounds": self.exchange_rounds,
+                             "wire_bytes": self.exchange_wire,
+                             # logical/wire ratio over the request's
+                             # codec-compressed exchanges ONLY (raw-
+                             # bypass traffic excluded; 0 = none ran)
+                             "compression_ratio": round(
+                                 self.exchange_wire_logical
+                                 / self.exchange_wire, 4)
+                             if self.exchange_wire else 0.0},
                 "spill": {"write_bytes": self.spill_write,
                           "read_bytes": self.spill_read},
                 "hbm": {"hi_water_bytes": self.mem_hi_water},

@@ -517,9 +517,12 @@ def record_exchange(stats) -> None:
                     "shuffle exchange() calls").inc()
         b = reg.counter("mrtpu_exchange_bytes_total",
                         "bytes moved by exchanges: useful (sent) vs "
-                        "static-shape padding slack (pad)", ("kind",))
+                        "static-shape padding slack (pad) at logical "
+                        "row width, and actual interconnect bytes "
+                        "after the MRTPU_WIRE codec (wire)", ("kind",))
         b.inc(int(stats.sent_bytes), kind="sent")
         b.inc(int(stats.pad_bytes), kind="pad")
+        b.inc(int(getattr(stats, "wire_bytes", 0)), kind="wire")
         reg.counter("mrtpu_exchange_rounds_total",
                     "flow-control rounds across exchanges"
                     ).inc(int(stats.nrounds))

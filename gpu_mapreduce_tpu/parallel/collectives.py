@@ -48,6 +48,9 @@ def gather_kv(backend, mr, nprocs: int):
         # empty dataset, not deleted buffers (shuffle.free_if_donated)
         free_if_donated(mr.kv, skv)
         raise
+    # per-call stats like aggregate's: gather/scrunch exchanges were
+    # invisible to mr.last_exchange (the bench --wire A/B reads it)
+    mr.last_exchange = getattr(out, "exchange_stats", None)
     _replace_kv_frames(mr.kv, out)
 
 

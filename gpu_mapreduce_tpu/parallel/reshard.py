@@ -32,6 +32,14 @@ exchange output preserves exact global row order — an N→M→N round trip
 is byte-identical (``tests/test_elastic.py``), and the whole thing runs
 under the ft/ ``shuffle.exchange`` retry policy like every exchange.
 
+Range exchanges ride the SAME ``exchange()`` core as dest-fn shuffles,
+so they inherit the wire codec (``parallel/wire.py``, MRTPU_WIRE —
+delta-packed keys, narrow values, tiered caps; the KMV value pass's
+1-byte rider ships raw by construction) and feed the same telemetry:
+``record_exchange`` sent/pad/wire bytes, ``mr.counters`` cssize/cspad,
+and the active RequestAccount — pinned by
+``tests/test_wire.py::test_range_reshard_feeds_exchange_metrics``.
+
 KMV datasets reshard at GROUP granularity: groups stay atomic (a
 group's value run never splits across shards).  The group-boundary
 schedule needs the per-group value counts — an O(groups) metadata pull,

@@ -116,6 +116,17 @@ def round_cap(n: int) -> int:
     return cap
 
 
+def narrowest_uint(maxval: int):
+    """(dtype name, itemsize) of the narrowest unsigned dtype holding
+    ``maxval`` — the wire codec's width rule (parallel/wire.py), kept
+    next to :func:`round_cap` so every capacity/width policy of the
+    sharded tier lives in one place."""
+    for name, width in (("uint8", 1), ("uint16", 2), ("uint32", 4)):
+        if maxval <= (1 << (8 * width)) - 1:
+            return name, width
+    return "uint64", 8
+
+
 def _pad_rows(arr: np.ndarray, cap: int) -> np.ndarray:
     pad = cap - arr.shape[0]
     if pad <= 0:

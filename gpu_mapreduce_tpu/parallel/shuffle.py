@@ -75,9 +75,11 @@ def default_hash(keys):
 
 _MAX_ROUNDS = 16     # unrolled in the jitted phase2; bounds trace size
 
-def _phase1(nprocs: int, dest_of: Callable, key, value, count):
-    """Per-shard: dest per row, stable sort rows by dest, per-dest counts.
-    Padding rows get dest=nprocs (dropped later)."""
+def _phase1_core(nprocs: int, dest_of: Callable, key, value, count):
+    """Per-shard: dest per row, stable sort rows by dest, per-dest
+    counts.  Padding rows get dest=nprocs (dropped later).  Returns the
+    per-row dest too so the wire variant's bucket stats share one dest
+    computation."""
     cap = key.shape[0]
     valid = jnp.arange(cap) < count
     dest = jnp.where(valid, dest_of(key).astype(jnp.int32), nprocs)
@@ -85,13 +87,19 @@ def _phase1(nprocs: int, dest_of: Callable, key, value, count):
     skey = jnp.take(key, order, axis=0)
     svalue = jnp.take(value, order, axis=0)
     counts_local = jnp.bincount(dest, length=nprocs + 1)[:nprocs].astype(jnp.int32)
-    return skey, svalue, counts_local
+    return skey, svalue, counts_local, dest
 
 
-def _build_send(nprocs: int, B: int, rows, counts_local, round_idx: int = 0):
-    """Scatter dest-sorted rows into a [P, B, ...] send buffer; with
-    ``round_idx`` r only bucket positions [rB, rB+B) are taken — the
-    multi-round slice of the flow-controlled exchange."""
+def _phase1(nprocs: int, dest_of: Callable, key, value, count):
+    return _phase1_core(nprocs, dest_of, key, value, count)[:3]
+
+
+def _build_send_window(nprocs: int, B: int, start: int, rows,
+                       counts_local):
+    """Scatter dest-sorted rows into a [P, B, ...] send buffer, taking
+    only bucket positions [start, start+B) — the window slice of the
+    flow-controlled exchange (uniform rounds use start = r*B; the wire
+    codec's tiered caps use the running tier offset)."""
     cap = rows.shape[0]
     cum = jnp.cumsum(counts_local)
     r = jnp.arange(cap)
@@ -101,12 +109,17 @@ def _build_send(nprocs: int, B: int, rows, counts_local, round_idx: int = 0):
     # rows outside this round's window must go POSITIVELY out of bounds:
     # a negative q wraps NumPy-style (idx+B) before mode="drop" checks, so
     # earlier rounds' rows would scatter into [0,B) and corrupt this round
-    in_window = (q0 >= round_idx * B) & (q0 < (round_idx + 1) * B)
-    q = jnp.where(in_window, q0 - round_idx * B, B)
+    in_window = (q0 >= start) & (q0 < start + B)
+    q = jnp.where(in_window, q0 - start, B)
     shape = (nprocs, B) + rows.shape[1:]
     send = jnp.zeros(shape, rows.dtype)
     # rows with d==nprocs (padding) or q==B (other round) → dropped
     return send.at[d, q].set(rows, mode="drop")
+
+
+def _build_send(nprocs: int, B: int, rows, counts_local, round_idx: int = 0):
+    """Uniform-round window: bucket positions [rB, rB+B)."""
+    return _build_send_window(nprocs, B, round_idx * B, rows, counts_local)
 
 
 def _ring_exchange(send, mesh):
@@ -242,7 +255,7 @@ PHASE2_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
                         name="shuffle.phase2")
 
 
-def _phase1_jit(mesh, dest, donate: bool = False):
+def _phase1_jit(mesh, dest, donate: bool = False, wire=None):
     """Cache the jitted phase1 only for stable dest specs — a per-call
     user hash lambda would defeat reuse (and one-shot entries would
     churn the LRU), so those build uncached (old behavior).
@@ -251,23 +264,43 @@ def _phase1_jit(mesh, dest, donate: bool = False):
     the dest-sorted outputs are same-shape/dtype, so XLA aliases the
     input buffers instead of materialising a second copy; the caller's
     arrays are DELETED at dispatch and must be dead (the exchange's
-    input dataset is — it is replaced by the exchange output)."""
+    input dataset is — it is replaced by the exchange output).
+
+    ``wire=(k_elig, v_elig)`` (parallel/wire.py, MRTPU_WIRE): the same
+    program ALSO emits per-destination bucket min/max stats — a fourth
+    [P, 4] uint64 output the wire codec's host planner reads alongside
+    the count matrix.  Part of the cache key: the wire and raw programs
+    have different output signatures."""
     if dest[0] == "hash" and dest[1] is not None:
-        return _phase1_build(mesh, dest, donate)
+        return _phase1_build(mesh, dest, donate, wire)
     return PHASE1_CACHE.get_or_build(
-        (mesh, dest, donate), lambda: _phase1_build(mesh, dest, donate))
+        (mesh, dest, donate, wire),
+        lambda: _phase1_build(mesh, dest, donate, wire))
 
 
-def _phase1_build(mesh, dest, donate: bool = False):
+def _phase1_build(mesh, dest, donate: bool = False, wire=None):
     nprocs = mesh_axis_size(mesh)
     dest_of = _dest_fn(dest, nprocs, mesh)
     spec = row_spec(mesh)
 
+    if wire is None:
+        def body(k, v, c):
+            return _phase1(nprocs, dest_of, k, v, c)
+        nouts = 3
+    else:
+        from .wire import bucket_stats
+        k_elig, v_elig = wire
+
+        def body(k, v, c):
+            sk, sv, cl, d = _phase1_core(nprocs, dest_of, k, v, c)
+            return sk, sv, cl, bucket_stats(nprocs, k, v, d,
+                                            k_elig, v_elig)
+        nouts = 4
+
     def phase1(key, value, count):
-        f = functools.partial(_phase1, nprocs, dest_of)
         return jax.shard_map(
-            f, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=(spec, spec, spec))(key, value, count)
+            body, mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=(spec,) * nouts)(key, value, count)
 
     # phase 1 is shape-preserving (dest-sorted rows), so donation always
     # aliases — the biggest win, on every aggregate/gather
@@ -346,6 +379,40 @@ def _phase2_build(mesh, transport: int, B: int, nrounds: int, cap_out: int,
     return donated_jit(phase2, (0, 1) if donate else ())
 
 
+def _phase2_wire_jit(mesh, transport: int, tiers, cap_out: int, kpack,
+                     vpack, donate: bool = False):
+    """The wire-codec phase 2 (parallel/wire.py): same packed output as
+    :func:`_phase2_jit` byte for byte, but rows cross the interconnect
+    delta-packed at the planned widths with tiered round caps.  The
+    plan's every static knob keys the executable cache — the "wire in
+    the jit key" contract of doc/perf.md."""
+    return PHASE2_CACHE.get_or_build(
+        (mesh, transport, "wire", tiers, cap_out, kpack, vpack, donate),
+        lambda: _phase2_wire_build(mesh, transport, tiers, cap_out,
+                                   kpack, vpack, donate))
+
+
+def _phase2_wire_build(mesh, transport: int, tiers, cap_out: int, kpack,
+                       vpack, donate: bool = False):
+    from .wire import phase2_wire_shard_body
+    nprocs = mesh_axis_size(mesh)
+    spec = row_spec(mesh)
+
+    def phase2(skey, svalue, counts_local, stats_local):
+        def body(k, v, cl, st):
+            out_k, out_v, _ = phase2_wire_shard_body(
+                nprocs, transport, mesh, tiers, cap_out, kpack, vpack,
+                k, v, cl, st)
+            return out_k, out_v
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,) * 4,
+            out_specs=(spec, spec))(skey, svalue, counts_local,
+                                    stats_local)
+
+    from ..exec import donated_jit
+    return donated_jit(phase2, (0, 1) if donate else ())
+
+
 # speculative capacity cache (round 4, VERDICT r3 weak #5): composed
 # iterative commands pay the exchange's ONE host sync — the count-matrix
 # pull that sizes the bucket/round/output shapes — once per op, a full
@@ -418,15 +485,23 @@ class ExchangeCallStats:
     speculative: bool         # phase 2 ran on cached caps
     sent_bytes: int = 0
     pad_bytes: int = 0
+    # wire codec (parallel/wire.py, MRTPU_WIRE): actual interconnect
+    # bytes after delta/narrow packing + tiered caps, and the logical/
+    # wire compression ratio ((sent+pad)/wire).  0 = codec bypassed or
+    # MRTPU_WIRE=0 (the raw path's bytes ARE sent+pad).
+    wire_bytes: int = 0
+    wire_ratio: float = 0.0
 
 
-def exchange_volume(skv: ShardedKV, counts_mat, B: int, nrounds: int,
+def exchange_volume(skv: ShardedKV, counts_mat, slots: int,
                     nprocs: int) -> tuple:
-    """(moved, pad, rowbytes) of one exchange — shared by the eager
-    exchange and the plan/ fuser so their telemetry can never diverge.
-    Padding diagnosis (VERDICT r2 #5): the exchange physically moves
-    nrounds × [P,B] buckets per shard; the slack beyond the real rows is
-    pure padding volume.  Diagonal (self→self) slots never cross the
+    """(moved, pad, rowbytes) of one exchange at LOGICAL (unpacked) row
+    width — shared by the eager exchange and the plan/ fuser so their
+    telemetry can never diverge.  ``slots`` is the per-bucket slot
+    budget the flow-control plan exchanges (B*nrounds for the uniform
+    schedule, the tier-ladder sum under the wire codec).  Padding
+    diagnosis (VERDICT r2 #5): the slack beyond the real rows is pure
+    padding volume.  Diagonal (self→self) slots never cross the
     interconnect — excluded on BOTH sides so pad is directly comparable
     to cssize."""
     rowbytes = (skv.key.dtype.itemsize
@@ -435,7 +510,7 @@ def exchange_volume(skv: ShardedKV, counts_mat, B: int, nrounds: int,
                 * (skv.value.shape[-1] if skv.value.ndim > 1 else 1))
     useful = int(counts_mat.sum() - np.trace(counts_mat))
     moved = useful * rowbytes
-    sent_slots = nprocs * (nprocs - 1) * B * nrounds
+    sent_slots = nprocs * (nprocs - 1) * slots
     pad = max(0, sent_slots - useful) * rowbytes
     return moved, pad, rowbytes
 
@@ -522,8 +597,24 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
                       retryable=_retryable)
 
 
+def _dispatch_phase2(plan, mesh, transport, donate2, skey, svalue,
+                     counts_local, stats_local):
+    """Run one exchange plan (the tagged tuple of parallel/wire.py):
+    raw plans take the original counts-only program, wire plans the
+    codec program (which additionally consumes the phase-1 stats)."""
+    if plan[0] == "wire":
+        _tag, tiers, cap_out, kpack, vpack = plan
+        return _phase2_wire_jit(mesh, transport, tiers, cap_out, kpack,
+                                vpack, donate=donate2)(
+            skey, svalue, counts_local, stats_local)
+    _tag, B, nrounds, cap_out = plan
+    return _phase2_jit(mesh, transport, B, nrounds, cap_out,
+                       donate=donate2)(skey, svalue, counts_local)
+
+
 def _exchange_impl(skv: ShardedKV, dest, transport: int,
                    counters, sp) -> ShardedKV:
+    from . import wire as _wire
     mesh = skv.mesh
     nprocs = mesh_axis_size(mesh)
 
@@ -535,55 +626,74 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
     # exec.can_donate — ONE copy, shared with the fuser
     from ..exec import can_donate
     donate = can_donate(skv)
+    wire_on = _wire.wire_enabled()
+    elig = _wire.columns_eligible(skv.key, skv.value) if wire_on else None
 
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
     bump_dispatch()
-    skey, svalue, counts_local = _phase1_jit(mesh, dest, donate)(
-        skv.key, skv.value, counts_dev)
-    # speculative phase 2: enqueue with last time's caps BEFORE the
+    stats_local = None
+    if wire_on:
+        skey, svalue, counts_local, stats_local = _phase1_jit(
+            mesh, dest, donate, wire=elig)(skv.key, skv.value, counts_dev)
+    else:
+        skey, svalue, counts_local = _phase1_jit(mesh, dest, donate)(
+            skv.key, skv.value, counts_dev)
+    # speculative phase 2: enqueue with last time's plan BEFORE the
     # count-matrix pull, so the pull overlaps device work (async
     # dispatch) instead of gating it
     # dest is part of the key: a gather's fixed-dest exchange and an
     # aggregate's hash exchange over the same shapes have wildly
     # different bucket profiles — sharing one slot would cross-
-    # contaminate caps and waste speculative dispatches (r4 review)
+    # contaminate caps and waste speculative dispatches (r4 review).
+    # wire_on too: raw and wire plans are different executables
     spec_key = (mesh, transport, dest, skv.key.shape, skv.key.dtype.str,
-                skv.value.shape, skv.value.dtype.str)
+                skv.value.shape, skv.value.dtype.str, wire_on)
     with _SPEC_LOCK:
         spec = _SPEC_CACHE.get(spec_key)
     out_spec = None
     if spec is not None:
         bump_dispatch()
-        out_spec = _phase2_jit(mesh, transport, *spec)(
-            skey, svalue, counts_local)
+        out_spec = _dispatch_phase2(spec, mesh, transport, False,
+                                    skey, svalue, counts_local,
+                                    stats_local)
     SyncStats.bump()   # the op's ONE round-trip: the count matrix
     from ..obs import get_tracer
     with get_tracer().span("shuffle.count_sync", cat="shuffle"):
         # the host pull that sizes the exchange — with a speculative
-        # phase 2 in flight this overlaps device work
+        # phase 2 in flight this overlaps device work.  The wire stats
+        # ride the same sync point (a second small transfer, not a
+        # second barrier)
         counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
+        stats_mat = (np.asarray(stats_local).reshape(nprocs, nprocs, 4)
+                     if stats_local is not None else None)
     # round budget: pad buckets to ~the mean nonzero bucket, not the max —
     # under key skew (RMAT hubs) the max bucket is far above the mean and
     # single-round padding would inflate the exchanged volume by that
     # ratio.  Up to _MAX_ROUNDS rounds of [P, B] each (uniform data stays
-    # one round since mean == max).
-    B, nrounds, cap_out, Bmax, new_counts = _plan_caps(counts_mat)
-    nmax_out = max(int(new_counts.max()), 8)
-    if out_spec is not None and Bmax <= spec[0] * spec[1] \
-            and nmax_out <= spec[2]:
+    # one round since mean == max).  The wire planner then tightens the
+    # schedule (tier ladder) and picks the pack widths — ONE planning
+    # step shared with the fused tier (wire.plan_from_pull)
+    plan, kvrange, bmax_raw, nmax_out, new_counts = _wire.plan_from_pull(
+        skv.key, skv.value, counts_mat, stats_mat, wire_on, elig)
+    if out_spec is not None and _wire.plan_holds(spec, bmax_raw,
+                                                 nmax_out, kvrange):
         # speculation holds: no row would have overflowed a bucket
-        # window or an output shard — keep the already-running result
+        # window or an output shard, and a cached pack width still
+        # round-trips the fresh ranges — keep the already-running result
         out_k, out_v = out_spec
         sp.set(speculative=True)
-        oversized = (spec[0] * spec[1] > 4 * max(Bmax, 8)
-                     or spec[2] > 4 * round_cap(nmax_out))
         # a grossly over-sized speculation right-sizes the cache for
-        # next time; padding/stats below reflect the caps that RAN
+        # next time, and a plan-TAG mismatch migrates the entry (a raw
+        # plan cached from a wide first run must not pin compressible
+        # repeats to full-width bytes forever); padding/stats below
+        # reflect the plan that RAN
         with _SPEC_LOCK:
-            _SPEC_CACHE[spec_key] = (B, nrounds, cap_out) if oversized \
+            _SPEC_CACHE[spec_key] = plan if (
+                spec[0] != plan[0]
+                or _wire.plan_oversized(spec, bmax_raw, nmax_out)) \
                 else spec
-        B, nrounds, cap_out = spec
+        ran = spec
     else:
         sp.set(speculative=False)
         bump_dispatch()
@@ -593,30 +703,43 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
         # never donates: a failed speculation re-runs phase 2 on the
         # same inputs
         donate2 = (donate
-                   and cap_out == skey.shape[0] // max(nprocs, 1))
-        out_k, out_v = _phase2_jit(mesh, transport, B, nrounds, cap_out,
-                                   donate=donate2)(
-            skey, svalue, counts_local)
+                   and _wire.plan_cap_out(plan)
+                   == skey.shape[0] // max(nprocs, 1))
+        out_k, out_v = _dispatch_phase2(plan, mesh, transport, donate2,
+                                        skey, svalue, counts_local,
+                                        stats_local)
         with _SPEC_LOCK:
-            _SPEC_CACHE[spec_key] = (B, nrounds, cap_out)
+            _SPEC_CACHE[spec_key] = plan
+        ran = plan
 
+    B_eff, nrounds_eff = _wire.plan_rounds(ran)
+    cap_out_eff = _wire.plan_cap_out(ran)
     # one tuple assignment: a concurrent world's exchange can interleave
     # here, but a reader then sees ONE exchange's (nrounds, bucket) pair,
     # never a torn mix (VERDICT r4 weak #7) — deprecated shim; the
     # per-call truth is the ExchangeCallStats built below
-    ExchangeStats.last = (nrounds, B)
-    stats = ExchangeCallStats(nrounds=nrounds, bucket=B, cap_out=cap_out,
+    ExchangeStats.last = (nrounds_eff, B_eff)
+    stats = ExchangeCallStats(nrounds=nrounds_eff, bucket=B_eff,
+                              cap_out=cap_out_eff,
                               rows=int(counts_mat.sum()),
                               speculative=out_spec is not None
                               and (out_k is out_spec[0]))
-    sp.set(bucket=B, nrounds=nrounds, cap_out=cap_out,
+    sp.set(bucket=B_eff, nrounds=nrounds_eff, cap_out=cap_out_eff,
            rows=stats.rows)
+    # byte accounting ALWAYS lands on the per-call stats (and so the
+    # live metrics + request profile), whether or not a Counters object
+    # rides along — a direct reshard/gather caller without counters
+    # must not read as "no exchange traffic" on /metrics
+    moved, pad, rowbytes = exchange_volume(skv, counts_mat,
+                                           _wire.plan_slots(ran), nprocs)
+    stats.sent_bytes, stats.pad_bytes = moved, pad
+    if ran[0] == "wire":
+        stats.wire_bytes = _wire.wire_volume(skv, counts_mat, ran)
+        stats.wire_ratio = _wire.wire_ratio(moved, pad, stats.wire_bytes)
+    sp.set(sent_bytes=moved, pad_bytes=pad, rowbytes=rowbytes,
+           wire_bytes=stats.wire_bytes, wire_ratio=stats.wire_ratio)
     if counters is not None:
-        moved, pad, rowbytes = exchange_volume(skv, counts_mat, B,
-                                               nrounds, nprocs)
         counters.add(cssize=moved, crsize=moved, cspad=pad)
-        sp.set(sent_bytes=moved, pad_bytes=pad, rowbytes=rowbytes)
-        stats.sent_bytes, stats.pad_bytes = moved, pad
     out = ShardedKV(mesh, out_k, out_v, new_counts,
                     key_decode=skv.key_decode,
                     value_decode=skv.value_decode)

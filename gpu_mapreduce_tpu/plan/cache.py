@@ -14,6 +14,13 @@ Two problems, one mechanism:
 worlds record/execute plans from interpreter threads), move-to-back on
 hit, evict-front past ``maxsize``, with cumulative hit/miss/eviction
 counters that ``MapReduce.stats()`` and the obs spans report.
+
+Key discipline: every knob that changes a compiled program's BYTES must
+be in its cache key — the plan cache keys (fingerprint, frame
+signature, backend, transport, outofcore, ``MRTPU_WIRE``), and the
+shuffle/fused executable caches additionally key the wire codec's full
+plan tuple (tier ladder + pack dtypes; ``parallel/wire.py``), so
+flipping a knob can never replay a stale executable.
 """
 
 from __future__ import annotations

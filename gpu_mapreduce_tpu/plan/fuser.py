@@ -212,36 +212,58 @@ def _donate_argnums(donate: bool, aliasable_dim0: bool, out_kind: str,
     return (0,)
 
 
-def _fused_exchange_jit(mesh, transport: int, B: int, nrounds: int,
-                        cap_out: int, out_kind: str,
+def _fused_exchange_jit(mesh, transport: int, plan, out_kind: str,
                         reduce_op: Optional[str], donate_argnums=()):
-    key = ("exchange", mesh, transport, B, nrounds, cap_out, out_kind,
+    """``plan`` is the tagged exchange plan (parallel/wire.py): raw
+    plans compose the original phase-2 body, wire plans the codec body —
+    either way every static knob of the plan keys the executable cache."""
+    key = ("exchange", mesh, transport, plan, out_kind,
            reduce_op, tuple(donate_argnums))
     return FUSED_CACHE.get_or_build(
-        key, lambda: _fused_exchange_build(mesh, transport, B, nrounds,
-                                           cap_out, out_kind, reduce_op,
+        key, lambda: _fused_exchange_build(mesh, transport, plan,
+                                           out_kind, reduce_op,
                                            donate_argnums))
 
 
-def _fused_exchange_build(mesh, transport, B, nrounds, cap_out, out_kind,
+def _fused_exchange_build(mesh, transport, plan, out_kind,
                           reduce_op, donate_argnums=()):
     import jax
     from ..exec import donated_jit
     from ..parallel.mesh import mesh_axis_size, row_spec
     from ..parallel.shuffle import phase2_shard_body
+    from ..parallel.wire import phase2_wire_shard_body, plan_cap_out
     nprocs = mesh_axis_size(mesh)
     spec = row_spec(mesh)
     nouts = 5 if out_kind == "kmv" else 3
+    cap_out = plan_cap_out(plan)
 
-    def run(skey, svalue, counts_local):
-        def body(k, v, cl):
-            out_k, out_v, nrecv = phase2_shard_body(
-                nprocs, transport, mesh, B, nrounds, cap_out, k, v, cl)
-            return _group_reduce_body(out_k, out_v, nrecv, cap_out,
-                                      out_kind, reduce_op)
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=(spec,) * nouts)(skey, svalue, counts_local)
+    if plan[0] == "wire":
+        _tag, tiers, _cap, kpack, vpack = plan
+
+        def run(skey, svalue, counts_local, stats_local):
+            def body(k, v, cl, st):
+                out_k, out_v, nrecv = phase2_wire_shard_body(
+                    nprocs, transport, mesh, tiers, cap_out, kpack,
+                    vpack, k, v, cl, st)
+                return _group_reduce_body(out_k, out_v, nrecv, cap_out,
+                                          out_kind, reduce_op)
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(spec,) * 4,
+                out_specs=(spec,) * nouts)(skey, svalue, counts_local,
+                                           stats_local)
+    else:
+        _tag, B, nrounds, _cap = plan
+
+        def run(skey, svalue, counts_local):
+            def body(k, v, cl):
+                out_k, out_v, nrecv = phase2_shard_body(
+                    nprocs, transport, mesh, B, nrounds, cap_out, k, v,
+                    cl)
+                return _group_reduce_body(out_k, out_v, nrecv, cap_out,
+                                          out_kind, reduce_op)
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=(spec,) * nouts)(skey, svalue, counts_local)
 
     # exec/: the dest-sorted phase-1 intermediates are dead after the
     # fused program — donate the aliasable ones (MRTPU_DONATE)
@@ -356,14 +378,18 @@ def _install_kmv(mr, skmv):
 
 def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
                          gidx: int, sp, frame):
-    """Run [aggregate, convert(, reduce)] as phase1 + ONE fused program."""
+    """Run [aggregate, convert(, reduce)] as phase1 + ONE fused program.
+    Under ``MRTPU_WIRE`` the fused program is the wire-codec variant
+    (parallel/wire.py): the rows cross the interconnect delta-packed
+    with tiered caps and decode inside the same program, so the grouped
+    output stays byte-identical to the eager tiers."""
     import jax
     from ..core.runtime import Timer, bump_dispatch
+    from ..parallel import wire as _wire
     from ..parallel.mesh import mesh_axis_size, row_sharding
-    from ..parallel.sharded import (ShardedKMV, ShardedKV, SyncStats,
-                                    round_cap)
+    from ..parallel.sharded import ShardedKMV, ShardedKV, SyncStats
     from ..parallel.shuffle import (ExchangeCallStats, ExchangeStats,
-                                    _phase1_jit, _plan_caps)
+                                    _phase1_jit)
 
     mesh = mr.backend.mesh
     nprocs = mesh_axis_size(mesh)
@@ -375,37 +401,51 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
     skv = _as_sharded(mr, frame)
     from ..exec import can_donate
     donate = can_donate(skv)
+    wire_on = _wire.wire_enabled()
+    elig = _wire.columns_eligible(skv.key, skv.value) if wire_on else None
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
     t = Timer()
     bump_dispatch()
-    skey, svalue, counts_local = _phase1_jit(mesh, dest, donate)(
-        skv.key, skv.value, counts_dev)
+    stats_local = None
+    if wire_on:
+        skey, svalue, counts_local, stats_local = _phase1_jit(
+            mesh, dest, donate, wire=elig)(skv.key, skv.value, counts_dev)
+    else:
+        skey, svalue, counts_local = _phase1_jit(mesh, dest, donate)(
+            skv.key, skv.value, counts_dev)
     SyncStats.bump()   # the op's ONE round-trip: the count matrix
     counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
-    B, nrounds, cap_out, Bmax, new_counts = _plan_caps(counts_mat)
-    nmax_out = max(int(new_counts.max()), 8)
-    cached_caps = compiled.caps.get(gidx)
-    if cached_caps is not None and Bmax <= cached_caps[0] * cached_caps[1] \
-            and nmax_out <= cached_caps[2] \
-            and cached_caps[0] * cached_caps[1] <= 4 * max(Bmax, 8) \
-            and cached_caps[2] <= 4 * round_cap(nmax_out):
-        # cached caps still hold every row and aren't grossly oversized:
-        # reuse the compiled program
-        B, nrounds, cap_out = cached_caps
+    stats_mat = (np.asarray(stats_local).reshape(nprocs, nprocs, 4)
+                 if stats_local is not None else None)
+    # ONE planning step shared with the eager exchange (wire.plan_from_
+    # pull): plan choice and telemetry must never diverge between tiers
+    plan, kvrange, bmax_raw, nmax_out, _new_counts = _wire.plan_from_pull(
+        skv.key, skv.value, counts_mat, stats_mat, wire_on, elig)
+    cached = compiled.caps.get(gidx)
+    if cached is not None and cached[0] == plan[0] \
+            and _wire.plan_holds(cached, bmax_raw, nmax_out, kvrange) \
+            and not _wire.plan_oversized(cached, bmax_raw, nmax_out):
+        # the cached plan still holds every row exactly and isn't
+        # grossly oversized: reuse the compiled program
+        plan = cached
     else:
         # too small OR ≥4× too large (skewed first run followed by
         # uniform data would pay the padded transfer forever, like the
-        # eager speculative cache's right-sizing): recompile at fresh caps
-        compiled.caps[gidx] = (B, nrounds, cap_out)
+        # eager speculative cache's right-sizing): recompile at the
+        # fresh plan
+        compiled.caps[gidx] = plan
+    cap_out = _wire.plan_cap_out(plan)
     bump_dispatch()
     argnums = _donate_argnums(
         donate, cap_out == skey.shape[0] // max(nprocs, 1), out_kind,
         reduce_op, svalue)
-    out = _fused_exchange_jit(mesh, transport, B, nrounds, cap_out,
-                              out_kind, reduce_op,
-                              donate_argnums=argnums)(skey, svalue,
-                                                      counts_local)
+    fused = _fused_exchange_jit(mesh, transport, plan, out_kind,
+                                reduce_op, donate_argnums=argnums)
+    if plan[0] == "wire":
+        out = fused(skey, svalue, counts_local, stats_local)
+    else:
+        out = fused(skey, svalue, counts_local)
     meta = np.asarray(out[-1]).reshape(nprocs, 2)
     gcounts = meta[:, 0].astype(np.int32)
     vcounts = meta[:, 1].astype(np.int32)
@@ -413,13 +453,16 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
     nrows = int(counts_mat.sum())
     ngroups = int(gcounts.sum())
     # exchange byte accounting + per-call stats, like the eager exchange
-    stats = ExchangeCallStats(nrounds=nrounds, bucket=B, cap_out=cap_out,
-                              rows=nrows, speculative=False)
-    _account_exchange(mr, skv, counts_mat, B, nrounds, nprocs, stats)
-    ExchangeStats.last = (nrounds, B)   # deprecated shim
+    B_eff, nrounds_eff = _wire.plan_rounds(plan)
+    stats = ExchangeCallStats(nrounds=nrounds_eff, bucket=B_eff,
+                              cap_out=cap_out, rows=nrows,
+                              speculative=False)
+    _account_exchange(mr, skv, counts_mat, plan, nprocs, stats)
+    ExchangeStats.last = (nrounds_eff, B_eff)   # deprecated shim
     mr.last_exchange = stats
-    sp.set(bucket=B, nrounds=nrounds, cap_out=cap_out, rows=nrows,
-           groups=ngroups)
+    sp.set(bucket=B_eff, nrounds=nrounds_eff, cap_out=cap_out,
+           rows=nrows, groups=ngroups, wire_bytes=stats.wire_bytes,
+           wire_ratio=stats.wire_ratio)
     stages[0].result = nrows
     stages[1].result = ngroups
     if out_kind == "kv":
@@ -444,13 +487,17 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
         _install_kmv(mr, skmv)
 
 
-def _account_exchange(mr, skv, counts_mat, B, nrounds, nprocs, stats):
+def _account_exchange(mr, skv, counts_mat, plan, nprocs, stats):
     from ..obs.metrics import record_exchange
     from ..parallel.shuffle import exchange_volume
-    moved, pad, _rowbytes = exchange_volume(skv, counts_mat, B, nrounds,
-                                            nprocs)
+    from ..parallel.wire import plan_slots, wire_ratio, wire_volume
+    moved, pad, _rowbytes = exchange_volume(skv, counts_mat,
+                                            plan_slots(plan), nprocs)
     mr.counters.add(cssize=moved, crsize=moved, cspad=pad)
     stats.sent_bytes, stats.pad_bytes = moved, pad
+    if plan[0] == "wire":
+        stats.wire_bytes = wire_volume(skv, counts_mat, plan)
+        stats.wire_ratio = wire_ratio(moved, pad, stats.wire_bytes)
     # the fused tier's twin of the eager _exchange_impl feed: without it
     # a MRTPU_FUSE=1 run reads "no exchange traffic" on /metrics
     record_exchange(stats)
@@ -518,9 +565,13 @@ def execute_plan(mr, plan: Plan) -> None:
     if kv is not None and kv.complete_done and kv._frames:
         frame = kv._frames[0]
     try:
+        # MRTPU_WIRE is part of the key: a cached wire plan's caps are
+        # tier/pack tuples a raw run can't validate against (and vice
+        # versa), so the two knob states never share an entry
+        from ..parallel.wire import wire_enabled
         key = (plan.fingerprint(), frame_signature(frame),
                _backend_signature(mr), mr.settings.all2all,
-               mr.settings.outofcore)
+               mr.settings.outofcore, wire_enabled())
         compiled = plan_cache().get(key)
     except TypeError:       # unhashable stage arg: run uncached
         key = None
@@ -586,7 +637,7 @@ def _backend_signature(mr):
 def _key_brief(key) -> Optional[str]:
     if key is None:
         return None
-    fp, frame_sig, backend, transport, ooc = key
+    fp, frame_sig, backend, transport, ooc, wire = key
     ops = "→".join(s[0] for s in fp)
     return (f"ops[{ops}] frame{frame_sig!r} backend={backend[0]} "
-            f"all2all={transport} outofcore={ooc}")
+            f"all2all={transport} outofcore={ooc} wire={int(wire)}")

@@ -72,6 +72,15 @@ ADVISORY_METRICS = (
     # — advisory: a micro-cycle's wall is noisy at this scale; the
     # hard invariants live in tests/test_context.py
     ("profile_overhead_pct", -1),
+    # wire-codec rows (bench.py --wire, detail.wire_ab): exchanged-byte
+    # reduction + compression ratio on the skewed shuffle-bound
+    # intcount, and the codec's wall cost — advisory because the CPU
+    # fake-mesh walls are noisy; the hard invariants (byte identity,
+    # strictly fewer pad bytes) live in tests/test_wire.py
+    ("wire_bytes_reduction_pct", +1),
+    ("wire_compression_ratio", +1),
+    ("wire_intcount_sec", -1),
+    ("wire_wall_delta_pct", -1),
 )
 
 DEFAULT_WINDOW = 3
@@ -141,6 +150,18 @@ def record_metrics(rec: dict) -> Optional[dict]:
     pab = det.get("profile_ab") or {}
     if not pab.get("error") and pab.get("overhead_pct") is not None:
         m["profile_overhead_pct"] = pab["overhead_pct"]
+    wab = det.get("wire_ab") or {}
+    wic = wab.get("intcount") or {}
+    if not wab.get("error") and wic:
+        if wic.get("bytes_reduction_pct") is not None:
+            m["wire_bytes_reduction_pct"] = wic["bytes_reduction_pct"]
+        if wic.get("wall_delta_pct") is not None:
+            m["wire_wall_delta_pct"] = wic["wall_delta_pct"]
+        w1 = wic.get("wire1") or {}
+        if w1.get("compression_ratio"):
+            m["wire_compression_ratio"] = w1["compression_ratio"]
+        if w1.get("wall_s") is not None:
+            m["wire_intcount_sec"] = w1["wall_s"]
     el = det.get("elastic") or {}
     if not el.get("error"):
         walls = [v for k, v in el.items()

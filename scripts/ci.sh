@@ -56,6 +56,19 @@ check_metrics_doc() {
   python scripts/check_metrics_doc.py
 }
 
+run_wire_subset_quick() {
+  echo "== wire-codec subset (fast): codec round-trip + goldens =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_wire.py -q \
+      -k 'codec or golden' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_wire_subset_full() {
+  echo "== wire-codec subset (full): chaos, reshard, telemetry, spec =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_wire.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_elastic_subset_quick() {
   echo "== elastic subset (fast): reshard unit + manifest round-trip =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
@@ -92,6 +105,7 @@ if [ "${1:-}" = "quick" ]; then
   run_serve_subset_quick
   run_context_subset
   run_elastic_subset_quick
+  run_wire_subset_quick
   bench_compare_advisory
   exit 0
 fi
@@ -114,4 +128,5 @@ run_ft_subset
 run_serve_subset_full
 run_context_subset
 run_elastic_subset_full
+run_wire_subset_full
 bench_compare_advisory

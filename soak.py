@@ -337,6 +337,44 @@ def main():
                   f"words/s, overlap ratio "
                   f"{ov.get('overlap_ratio', 0.0):.2f}")
 
+    def do_shuffle_skew():
+        # wire-codec row (parallel/wire.py): a zipf-keyed intcount-shape
+        # shuffle — maximum key cardinality, RMAT-hub skew, minimum
+        # payload — through aggregate/convert/count under the default
+        # MRTPU_WIRE, publishing sustained shuffle throughput and the
+        # exchange compression ratio the codec achieved (doc/perf.md).
+        # Needs a real multi-shard mesh: a 1-wide mesh never exchanges,
+        # so the row then reports ratio 0 with a note instead of lying
+        from gpu_mapreduce_tpu.oink.kernels import count as count_k
+        wmesh = mesh if nmesh > 1 else make_mesh(
+            min(8, len(jax.devices())))
+        rng6 = np.random.default_rng(29)
+        rows = min(max(nedges, 1 << 16), 1 << 21)
+        zkeys = np.minimum(rng6.zipf(1.3, rows),
+                           1 << 22).astype(np.uint64)
+        ones = np.ones(rows, np.uint32)
+
+        def run_shuffle():
+            mr = MapReduce(wmesh)
+            mr.map(1, lambda i, kv, p: kv.add_batch(zkeys, ones))
+            t0 = time.perf_counter()
+            mr.aggregate()
+            mr.convert()
+            nu = mr.reduce(count_k, batch=True)
+            return nu, time.perf_counter() - t0, mr.last_exchange
+
+        run_shuffle()                       # warm the compiles
+        nu, dt, st = run_shuffle()
+        published["shuffle_pairs_per_sec"] = round(rows / dt, 1)
+        ratio = float(getattr(st, "wire_ratio", 0.0) or 0.0)
+        published["wire_compression_ratio"] = round(ratio, 4)
+        from gpu_mapreduce_tpu.parallel.mesh import mesh_axis_size
+        width = mesh_axis_size(wmesh)
+        print(f"shuffle_skew: {rows} pairs, {nu} unique over "
+              f"{width} shards in {dt:.2f}s -> {rows / dt:,.0f} "
+              f"pairs/s, wire ratio {ratio:.2f}"
+              + (" (1-wide mesh: no exchange)" if width == 1 else ""))
+
     def do_pagerank():
         n = 1 << scale
         src = edges[:, 0].astype(np.int32)
@@ -654,6 +692,7 @@ def main():
                  ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),
                  ("external", do_external),
                  ("ingest", do_ingest_overlap),
+                 ("shuffle_skew", do_shuffle_skew),
                  ("pagerank", do_pagerank),
                  ("pagerank_northstar", do_pagerank_northstar),
                  ("serve", do_serve)]

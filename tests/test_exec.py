@@ -521,7 +521,10 @@ def test_failed_exchange_after_donation_leaves_clean_state(monkeypatch):
     keys = np.arange(1 << 12, dtype=np.uint64)
     mr.map(1, lambda i, kv, p: kv.add_batch(keys, keys))
     mr.aggregate()                      # install the sharded frame
+    # both phase-2 variants: the wire codec (MRTPU_WIRE, default on)
+    # dispatches _phase2_wire_jit instead of _phase2_jit
     monkeypatch.setattr(shuffle, "_phase2_jit", boom)
+    monkeypatch.setattr(shuffle, "_phase2_wire_jit", boom)
     shuffle._SPEC_CACHE.clear()
     with pytest.raises(RuntimeError, match="phase2 exploded"):
         mr.aggregate()                  # phase 1 donated, phase 2 died

@@ -212,6 +212,8 @@ def test_skewed_exchange_multi_round(mesh, all2all, monkeypatch):
     rng.shuffle(keys)
     vals = np.arange(len(keys), dtype=np.uint64)
 
+    monkeypatch.setenv("MRTPU_WIRE", "0")  # the RAW schedule under test
+    #                                        (wire twin: test_wire.py)
     seen = {}
     orig = shuffle._phase2_jit
 
@@ -448,6 +450,8 @@ def test_exchange_speculative_caps(mesh, monkeypatch):
     from gpu_mapreduce_tpu.parallel import shuffle
     from gpu_mapreduce_tpu.parallel.sharded import SyncStats, shard_frame
 
+    monkeypatch.setenv("MRTPU_WIRE", "0")  # the RAW caps under test
+    #                                        (wire twin: test_wire.py)
     calls = []
     orig = shuffle._phase2_jit
 
@@ -484,9 +488,10 @@ def test_exchange_speculative_caps(mesh, monkeypatch):
 
     xchg(uni)                       # skewed caps fit uniform (Bmax small)
     spec_after = shuffle._SPEC_CACHE[next(iter(shuffle._SPEC_CACHE))]
+    assert spec_after[0] == "raw"   # entries are tagged plans now
     assert len(calls) in (5, 6)     # hit (maybe oversized) or re-run
     if len(calls) == 5:             # held: cache must right-size if gross
-        assert spec_after[2] <= 4 * calls[0][2]
+        assert spec_after[3] <= 4 * calls[0][2]
 
 
 def test_add_cross_domain_keys_group(mesh):

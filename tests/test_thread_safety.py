@@ -68,7 +68,8 @@ def test_two_worlds_exchange_concurrently():
 def test_spec_cache_concurrent_population():
     """Hammer the speculative-cap cache dict from two threads with
     DISTINCT specs (different meshes) — entries must not be lost or
-    torn (each value is a well-formed 3-tuple)."""
+    torn (each value is a well-formed tagged exchange plan,
+    parallel/wire.py)."""
     devs = list(make_mesh(8).devices.flat)
     meshes = [make_mesh(devices=devs[:4]), make_mesh(devices=devs[4:])]
     errs = []
@@ -95,5 +96,9 @@ def test_spec_cache_concurrent_population():
     assert not errs, errs
     with shuffle._SPEC_LOCK:
         vals = list(shuffle._SPEC_CACHE.values())
-    assert vals and all(isinstance(v, tuple) and len(v) == 3
-                        for v in vals)
+    assert vals and all(
+        isinstance(v, tuple)
+        and ((v[0] == "raw" and len(v) == 4)
+             or (v[0] == "wire" and len(v) == 5
+                 and isinstance(v[1], tuple)))
+        for v in vals)
