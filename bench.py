@@ -556,6 +556,8 @@ def profile_ab_record() -> dict:
         mr.sort_keys(1)
 
     tracer = get_tracer()
+    # mrlint: disable=knob-bypass — raw save/restore of the var for the
+    # A/B (must keep the None-vs-"" distinction env_str collapses)
     prev_profile = os.environ.get("MRTPU_PROFILE")
     prev_enabled = tracer.enabled
     best = {"off": float("inf"), "on": float("inf")}

@@ -621,6 +621,18 @@ class InvertedIndex:
         if trigger:
             self._compact_chk_runs()
 
+    # mrlint: disable=lock-unguarded-mutation — only called from run()'s
+    # single-threaded phases: before map_files spawns the mapper pool
+    # and after it joins; the locked sites are the pool's
+    def _reset_chk(self, counters: bool) -> None:
+        """Drop the url-dict check accumulators between phases
+        (``counters=True`` also zeroes the cumulative raw/base stats —
+        the start-of-run reset; the post-compaction reset keeps them)."""
+        self._chk_tails = []
+        self._chk_sorted = None
+        if counters:
+            self._chk_raw = self._chk_base = 0
+
     def _compact_chk_runs(self):
         """Fold the recorded raw tails into the standing sorted deduped
         run, raising if any id carries two distinct alt values.  Only
@@ -962,9 +974,7 @@ class InvertedIndex:
                 self.docs = list(files)
                 self._keep_bytes = _url_dict_wanted(files,
                                                     outdir is not None)
-                self._chk_tails = []
-                self._chk_sorted = None
-                self._chk_raw = self._chk_base = 0
+                self._reset_chk(counters=True)
                 self.stats["nbatches"] = len(files)
                 # collisions surface inside _fold_id_check as files map,
                 # or in the close-out compaction below (cross-batch);
@@ -974,8 +984,7 @@ class InvertedIndex:
                 if self._chk_tails:
                     with self.timer.stage("host_add"):
                         self._compact_chk_runs()
-                self._chk_tails = []
-                self._chk_sorted = None
+                self._reset_chk(counters=False)
             else:
                 self.npairs = mr.map(
                     1, lambda itask, kv, ptr: self._map_corpus_device(

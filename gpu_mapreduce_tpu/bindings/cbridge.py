@@ -84,6 +84,15 @@ def _register(obj) -> int:
     return h
 
 
+def _unregister(h: int):
+    """Locked twin of :func:`_register`: every ``_handles`` mutation
+    goes through ``_reg_lock`` (mapstyle-2 workers pop per-task
+    accumulators concurrently with registrations — mrlint
+    lock-discipline)."""
+    with _reg_lock:
+        return _handles.pop(h, None)
+
+
 def _get(h: int):
     return _handles[h]
 
@@ -127,7 +136,7 @@ def mr_create() -> int:
 
 
 def mr_destroy(h: int):
-    _handles.pop(h, None)
+    _unregister(h)
     _blockmeta.pop(h, None)
     _c_block_rows.pop(h, None)
 
@@ -163,7 +172,7 @@ def mr_map(h: int, nmap: int, fnptr: int, appptr: int, addflag: int) -> int:
             fn(itask, kvh, appptr)
             acc.flush()
         finally:
-            _handles.pop(kvh, None)
+            _unregister(kvh)
 
     return mr.map(nmap, wrapper, addflag=addflag)
 
@@ -181,7 +190,7 @@ def mr_map_file_list(h: int, paths: List[bytes], fnptr: int, appptr: int,
                kvh, appptr)
             acc.flush()
         finally:
-            _handles.pop(kvh, None)
+            _unregister(kvh)
 
     return mr.map_files([p.decode() for p in paths], wrapper,
                         addflag=addflag)
@@ -203,7 +212,7 @@ def mr_map_file_chunks(h: int, which: str, nmap: int, paths: List[bytes],
             fn(itask, buf, len(chunk), kvh, appptr)
             acc.flush()
         finally:
-            _handles.pop(kvh, None)
+            _unregister(kvh)
 
     files = [p.decode() for p in paths]
     if which == "char":
@@ -240,7 +249,7 @@ def mr_map_mr(h: int, h2: int, fnptr: int, appptr: int) -> int:
         return mr.map_mr(src, wrapper)
     finally:
         for kvh in reg.values():
-            _handles.pop(kvh, None)
+            _unregister(kvh)
 
 
 def mr_aggregate_hash(h: int, fnptr: int) -> int:
@@ -315,7 +324,7 @@ def _call_reduce(fn, appptr, key, vals, kv, mrh=None):
             fn(kb, len(kb), buf, len(bvals), sizes, kvh, appptr)
         acc.flush()
     finally:
-        _handles.pop(kvh, None)
+        _unregister(kvh)
 
 
 def mr_reduce(h: int, fnptr: int, appptr: int) -> int:
@@ -452,6 +461,6 @@ def oink_command(h: int, line: str) -> Optional[str]:
 
 
 def oink_close(h: int):
-    interp = _handles.pop(h, None)
+    interp = _unregister(h)
     if interp is not None:
         interp.close()

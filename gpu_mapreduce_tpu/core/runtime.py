@@ -33,6 +33,8 @@ import warnings
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils.env import env_knob, env_str
+
 
 class MRError(RuntimeError):
     """Raised for fatal conditions (the reference's error->all/one,
@@ -60,8 +62,8 @@ class Settings:
     # vars mirror the reference's compile-time default overrides
     # MRMPI_MEMSIZE / MRMPI_FPATH (mapreduce.cpp:206-229) — explicit
     # settings still win
-    memsize: int = field(default_factory=lambda: int(
-        os.environ.get("MRTPU_MEMSIZE", 64)))
+    memsize: int = field(default_factory=lambda: env_knob(
+        "MRTPU_MEMSIZE", int, 64))
     minpage: int = 0
     maxpage: int = 0        # max frames resident in HBM; 0 = unlimited
     freepage: int = 1
@@ -69,19 +71,19 @@ class Settings:
     zeropage: int = 0
     keyalign: int = 8       # accepted, ignored (columnar)
     valuealign: int = 8
-    fpath: str = field(default_factory=lambda: os.environ.get(
+    fpath: str = field(default_factory=lambda: env_str(
         "MRTPU_FPATH", "."))  # spill-file dir (reference MRMPI_FPATH)
     # 1 = defer op chains into the plan/ recorder and run them fused
     # (no reference analog — the reference is eager by construction);
     # the MRTPU_FUSE env var flips the default like MRTPU_MEMSIZE does
-    fuse: int = field(default_factory=lambda: int(
-        os.environ.get("MRTPU_FUSE", 0)))
+    fuse: int = field(default_factory=lambda: env_knob(
+        "MRTPU_FUSE", int, 0))
     # what a failed map input does after the ft/ retry budget is spent
     # (no reference analog — the reference aborts on any read error):
     # "fail" raises MRError, "retry" retries with a default budget even
     # when MRTPU_RETRY is unset, "skip" quarantines the poisoned input
     # and continues (records in mr.stats()["ft"] — doc/reliability.md)
-    onfault: str = field(default_factory=lambda: os.environ.get(
+    onfault: str = field(default_factory=lambda: env_str(
         "MRTPU_ONFAULT", "fail"))
 
     def validate(self, error: Error):

@@ -214,9 +214,10 @@ def configure_from_env() -> None:
     A malformed value warns and stays disarmed, never crashes the run
     (the utils.env contract)."""
     global _ARMED, _ENV_APPLIED
-    import os
     import sys
-    raw = os.environ.get("MRTPU_FAULTS", "")
+
+    from ..utils.env import env_str
+    raw = env_str("MRTPU_FAULTS", "")
     if raw == (_ENV_APPLIED or ""):
         return
     try:

@@ -281,7 +281,8 @@ def from_env(script_mode: bool = False) -> Optional[Journal]:
     appends through its own handle (concurrent scripts sharing one
     journal dir are unsupported for resume either way — journal per
     world, doc/reliability.md)."""
-    dir = os.environ.get("MRTPU_JOURNAL", "")
+    from ..utils.env import env_str
+    dir = env_str("MRTPU_JOURNAL", "")
     if not dir:
         return None
     j = Journal(dir, script_mode=script_mode)
@@ -302,11 +303,15 @@ def configure_from_env() -> None:
     journal in ``OinkScript.__init__`` (before any MR exists), which
     this never replaces."""
     global _ENV_APPLIED
-    raw = os.environ.get("MRTPU_JOURNAL", "")
-    if raw == (_ENV_APPLIED or ""):
-        return
-    _ENV_APPLIED = raw
+    from ..utils.env import env_str
+    raw = env_str("MRTPU_JOURNAL", "")
+    # check-and-set under _LOCK: two concurrent MapReduce constructions
+    # racing the compare outside the lock could both see "unapplied" and
+    # double-arm (the PR 6 counter-outside-lock class, caught by mrlint)
     with _LOCK:
+        if raw == (_ENV_APPLIED or ""):
+            return
+        _ENV_APPLIED = raw
         active_now = _ACTIVE
     if raw and active_now is None:
         try:

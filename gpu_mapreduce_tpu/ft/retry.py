@@ -116,9 +116,10 @@ def configure_from_env() -> None:
     respec replaces only ENV-sourced budgets — programmatic
     ``set_budget`` state survives (same contract as inject specs)."""
     global _ENV_APPLIED, _DEFAULT_BUDGET, _ENV_DEFAULT
-    import os
     import sys
-    raw = os.environ.get("MRTPU_RETRY", "")
+
+    from ..utils.env import env_str
+    raw = env_str("MRTPU_RETRY", "")
     if raw == (_ENV_APPLIED or ""):
         return
     try:

@@ -1,0 +1,287 @@
+"""trace-purity: no host effects inside traced program bodies.
+
+The paper's contract is "user code supplies serial callbacks; the
+library does all parallelism" — inside a ``jit``/``shard_map``/
+``pallas_call`` body that means NO host work: a ``print`` traces once
+and never again, ``time``/``random``/``os.environ`` reads bake one
+ambient value into a cached executable, a lock acquisition runs at
+trace time only (and orders against nothing at run time), and
+``.item()``/``float()``-style coercions force a device sync or crash
+under tracing outright.
+
+Entry points (the traced set's roots):
+
+* functions decorated ``@jax.jit`` / ``@jit`` /
+  ``@functools.partial(jax.jit, ...)``;
+* the callable passed to ``jax.shard_map`` / ``shard_map`` /
+  ``pallas_call`` / ``pl.pallas_call`` / ``jax.jit(...)`` /
+  ``donated_jit(...)`` (the repo's one donation-wrapping rule,
+  ``exec/__init__.py``).
+
+Everything reachable from an entry through the project callgraph is
+treated as traced.  Reachability is best-effort (unresolvable calls
+drop), so this rule under-approximates — it exists to catch the
+recurring review classes, not to prove purity.
+
+Rules emitted:
+
+* ``purity-host-call`` — print/open/time/random/os.environ/env_knob
+  reads in traced code;
+* ``purity-lock`` — lock acquisition (``with <lock>`` / ``.acquire()``)
+  in traced code;
+* ``purity-coerce`` — ``.item()`` anywhere, or ``float()/int()/bool()``
+  and ``np.asarray/np.array`` applied to a value data-flowed from a
+  traced entry's parameters (one-level positional taint propagation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import ENV_HELPERS as _ENV_HELPERS
+from .callgraph import (CallGraph, FuncInfo, env_reads, get_graph,
+                        name_chain)
+from .driver import Finding, Project, register
+
+_TRACE_WRAPPERS = {
+    ("jax", "shard_map"): 0, ("shard_map",): 0,
+    ("jax", "experimental", "shard_map", "shard_map"): 0,
+    ("pallas_call",): 0, ("pl", "pallas_call"): 0,
+    ("jax", "jit"): 0, ("jit",): 0, ("donated_jit",): 0,
+}
+
+_TIME_FNS = {"time", "perf_counter", "monotonic", "sleep",
+             "process_time", "time_ns", "perf_counter_ns"}
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    chain = name_chain(dec)
+    if chain and chain[-1] == "jit":
+        return True
+    if isinstance(dec, ast.Call):
+        chain = name_chain(dec.func)
+        if chain and chain[-1] == "jit":
+            return True
+        # functools.partial(jax.jit, ...)
+        if chain and chain[-1] == "partial" and dec.args:
+            inner = name_chain(dec.args[0])
+            if inner and inner[-1] == "jit":
+                return True
+    return False
+
+
+def _entries(graph: CallGraph) -> List[FuncInfo]:
+    roots: List[FuncInfo] = []
+    seen: Set[str] = set()
+
+    def add(info: Optional[FuncInfo]) -> None:
+        if info is not None and info.key not in seen:
+            seen.add(info.key)
+            roots.append(info)
+
+    for info in graph.funcs.values():
+        node = info.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                add(info)
+    for mod in graph.project.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func)
+            if chain is None:
+                continue
+            argpos = None
+            for pat, pos in _TRACE_WRAPPERS.items():
+                if chain[-len(pat):] == pat:
+                    argpos = pos
+                    break
+            if argpos is None or len(node.args) <= argpos:
+                continue
+            arg = node.args[argpos]
+            scope = graph.enclosing(mod, node)
+            if isinstance(arg, ast.Lambda):
+                add(graph.funcs.get(
+                    f"{mod.relpath}::"
+                    + (f"{scope.qual}.<lambda:{arg.lineno}>" if scope
+                       else f"<lambda:{arg.lineno}>")))
+                # fall through to name-chain lookup below for non-lambda
+                continue
+            achain = name_chain(arg)
+            if achain:
+                add(graph.resolve(mod, scope, achain))
+    return roots
+
+
+def _taint(graph: CallGraph, traced: List[FuncInfo],
+           entries: List[FuncInfo]) -> Dict[str, Set[str]]:
+    """function key -> set of local names carrying traced values."""
+    traced_keys = {f.key for f in traced}
+    taint: Dict[str, Set[str]] = {f.key: set(f.params) for f in entries}
+    by_key = {f.key: f for f in traced}
+    for _round in range(5):
+        changed = False
+        for info in traced:
+            names = taint.get(info.key, set())
+            # closure flow: a nested def sees its ancestors' taints
+            prefix = info.qual.rsplit(".", 1)[0] if "." in info.qual else ""
+            while prefix:
+                parent = taint.get(f"{info.module.relpath}::{prefix}")
+                if parent:
+                    names = names | parent
+                prefix = prefix.rsplit(".", 1)[0] if "." in prefix else ""
+            if names != taint.get(info.key, set()):
+                taint[info.key] = set(names)
+                changed = True
+            if not names:
+                continue
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = name_chain(node.func)
+                if not chain:
+                    continue
+                callee = graph.resolve(info.module, info, chain)
+                if callee is None or callee.key not in traced_keys:
+                    continue
+                tgt = taint.setdefault(callee.key, set())
+                for pos, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Name) and arg.id in names \
+                            and pos < len(callee.params):
+                        if callee.params[pos] not in tgt:
+                            tgt.add(callee.params[pos])
+                            changed = True
+        if not changed:
+            break
+    for key in list(taint):
+        if key in by_key:
+            # assignments from tainted expressions taint their targets
+            info = by_key[key]
+            names = taint[key]
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Assign):
+                    used = {n.id for n in ast.walk(node.value)
+                            if isinstance(n, ast.Name)}
+                    if used & names:
+                        for t in node.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    names.add(n.id)
+    return taint
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    chain = name_chain(expr)
+    if isinstance(expr, ast.Call):
+        chain = name_chain(expr.func)
+    if not chain:
+        return None
+    last = chain[-1].lower()
+    if "lock" in last or last in ("condition", "cv", "mutex"):
+        return ".".join(chain)
+    return None
+
+
+def check(project: Project) -> List[Finding]:
+    graph = get_graph(project)
+    entries = _entries(graph)
+    traced = graph.reachable(entries)
+    taint = _taint(graph, traced, entries)
+    out: List[Finding] = []
+
+    for info in traced:
+        mod = info.module
+        names = taint.get(info.key, set())
+        body = info.node
+        nested_spans = [
+            (f.node.lineno, f.node.end_lineno or f.node.lineno)
+            for f in traced
+            if f.module is mod and f.key != info.key
+            and f.qual.startswith(info.qual + ".")]
+
+        def owned(node) -> bool:
+            # skip nodes belonging to a nested traced def (they report
+            # under their own FuncInfo, once)
+            ln = getattr(node, "lineno", None)
+            if ln is None:
+                return False
+            return not any(a <= ln <= b for a, b in nested_spans)
+
+        def emit(rule, node, msg):
+            out.append(Finding(rule, mod.relpath, node.lineno, msg,
+                               symbol=info.qual))
+
+        for knob, node in env_reads(body):
+            # skip the registry helpers' own non-literal reads: if a
+            # traced body calls env_knob("MRTPU_X", ...), the call site
+            # reports with the real knob name; the helper body's
+            # os.environ.get(name) would only add an unactionable "?"
+            if info.qual in _ENV_HELPERS:
+                continue
+            if owned(node):
+                emit("purity-host-call", node,
+                     f"env read {knob!r} inside traced code bakes an "
+                     f"ambient value into a cached executable")
+        for node in ast.walk(body):
+            if not owned(node):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lk = _lockish(item.context_expr)
+                    if lk:
+                        emit("purity-lock", node,
+                             f"lock {lk!r} acquired inside traced code "
+                             f"(held at trace time only)")
+            if not isinstance(node, ast.Call):
+                continue
+            chain = name_chain(node.func) or ()
+            if chain == ("print",):
+                emit("purity-host-call", node,
+                     "print() inside traced code runs once at trace "
+                     "time, then never again")
+            elif chain == ("open",):
+                emit("purity-host-call", node,
+                     "open() inside traced code is a host file effect")
+            elif len(chain) == 2 and chain[0] == "time" \
+                    and chain[1] in _TIME_FNS:
+                emit("purity-host-call", node,
+                     f"time.{chain[1]}() inside traced code freezes one "
+                     f"trace-time value into the executable")
+            elif chain[:1] == ("random",) and len(chain) == 2:
+                emit("purity-host-call", node,
+                     f"random.{chain[1]}() inside traced code — use "
+                     f"jax.random with an explicit key")
+            elif len(chain) >= 3 and chain[0] in ("np", "numpy") \
+                    and chain[1] == "random":
+                emit("purity-host-call", node,
+                     "np.random inside traced code — use jax.random")
+            elif chain[-1:] == ("acquire",) and len(chain) >= 2 \
+                    and "lock" in chain[-2].lower():
+                emit("purity-lock", node,
+                     f"{'.'.join(chain)} inside traced code")
+            elif chain[-1:] == ("item",) and not node.args:
+                emit("purity-coerce", node,
+                     ".item() inside traced code forces a host sync "
+                     "(fails under tracing)")
+            elif chain in (("float",), ("int",), ("bool",)) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in names:
+                    emit("purity-coerce", node,
+                         f"{chain[0]}({arg.id}) coerces a traced value "
+                         f"on the host (fails under tracing)")
+            elif len(chain) == 2 and chain[0] in ("np", "numpy") \
+                    and chain[1] in ("asarray", "array", "save", "load") \
+                    and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in names:
+                    emit("purity-coerce", node,
+                         f"np.{chain[1]}({arg.id}) pulls a traced value "
+                         f"to the host")
+    return out
+
+
+register(
+    "trace-purity", check,
+    "host effects (print/time/random/env/lock/.item()/coercions) in "
+    "functions reachable from jit/shard_map/pallas_call bodies")

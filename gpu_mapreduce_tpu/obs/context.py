@@ -64,7 +64,8 @@ def profiling_enabled() -> bool:
     """The implicit-context knob (``MRTPU_PROFILE``, default on).
     Explicit scopes — :func:`request_scope`, the serve/ daemon's
     per-session install — always work regardless."""
-    return os.environ.get("MRTPU_PROFILE", "1") != "0"
+    from ..utils.env import env_flag
+    return env_flag("MRTPU_PROFILE", True)
 
 
 def new_trace_id() -> str:

@@ -43,7 +43,8 @@ class FlightRecorder:
 
     def __init__(self, dir: str = ".", capacity: Optional[int] = None):
         self.dir = dir
-        cap = capacity or int(os.environ.get("MRTPU_FLIGHT_RING", 2048))
+        from ..utils.env import env_knob
+        cap = capacity or env_knob("MRTPU_FLIGHT_RING", int, 2048)
         self.events: deque = deque(maxlen=cap)
         self._lock = threading.Lock()
         self._seq = 0
@@ -124,7 +125,8 @@ def enable(dir: Optional[str] = None,
     with _LOCK:
         if _RECORDER is None:
             if dir is None:
-                env = os.environ.get("MRTPU_FLIGHT", "")
+                from ..utils.env import env_str
+                env = env_str("MRTPU_FLIGHT", "")
                 dir = env if env not in ("", "0", "1") else "."
             _RECORDER = FlightRecorder(dir=dir, capacity=capacity)
         elif dir is not None:

@@ -467,7 +467,9 @@ def enable_metrics(flight: Optional[bool] = None) -> MetricsRegistry:
     get_tracer().subscribe_once(_bridge_emit)
     _ENABLED = True
     if flight is None:
-        flight = os.environ.get("MRTPU_FLIGHT", "") != "0"
+        from ..utils.env import env_str
+        # MRTPU_FLIGHT is a path-or-flag: any value but "0" arms it
+        flight = env_str("MRTPU_FLIGHT", "") != "0"
     if flight:
         try:
             from . import flight as _flight
@@ -607,7 +609,7 @@ def configure_from_env() -> None:
         # capture window must not quietly run with no live export
         print(f"{knob} ignored: {e!r}", file=sys.stderr)
 
-    from ..utils.env import env_knob
+    from ..utils.env import env_knob, env_str
     try:
         port = env_knob("MRTPU_METRICS_PORT", int, None)
         if port is not None:
@@ -617,14 +619,14 @@ def configure_from_env() -> None:
     except Exception as e:
         _warn("MRTPU_METRICS_PORT", e)
     try:
-        snap = os.environ.get("MRTPU_METRICS_SNAP")
+        snap = env_str("MRTPU_METRICS_SNAP", None)
         if snap:
             start_snapshotter(
                 snap, env_knob("MRTPU_METRICS_SNAP_SECS", float, 60.0))
     except Exception as e:
         _warn("MRTPU_METRICS_SNAP", e)
     try:
-        fl = os.environ.get("MRTPU_FLIGHT")
+        fl = env_str("MRTPU_FLIGHT", None)
         if fl and fl != "0":
             from . import flight as _flight
             _flight.enable()

@@ -351,7 +351,8 @@ def configure(objectives: List[SLOObjective]) -> SLOEngine:
         # until MRTPU_SLO actually changes — otherwise the very next
         # get_engine() (any metrics scrape) would see an "unapplied"
         # env string and silently evict the configured engine
-        _ENV_APPLIED = os.environ.get("MRTPU_SLO", "")
+        from ..utils.env import env_str
+        _ENV_APPLIED = env_str("MRTPU_SLO", "")
         return _ENGINE
 
 
@@ -360,8 +361,8 @@ def get_engine() -> Optional[SLOEngine]:
     the value changes; malformed values warn and disarm), or whatever
     :func:`configure` installed.  None when no objectives exist."""
     global _ENGINE, _ENV_APPLIED
-    import os
-    raw = os.environ.get("MRTPU_SLO", "")
+    from ..utils.env import env_str
+    raw = env_str("MRTPU_SLO", "")
     with _LOCK:
         if raw != (_ENV_APPLIED or ""):
             _ENV_APPLIED = raw

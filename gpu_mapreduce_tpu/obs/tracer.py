@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..utils.env import env_flag, env_knob, env_str
 from .context import current_trace_id as _ctx_trace_id
 from .context import note_span as _ctx_note_span
 
@@ -169,7 +170,7 @@ class Tracer:
             counters = global_counters()
         self.enabled = False
         self.counters = counters
-        self.jax_annotations = os.environ.get("MRTPU_TRACE_JAX", "1") == "1"
+        self.jax_annotations = env_flag("MRTPU_TRACE_JAX", True)
         self.epoch = time.perf_counter()
         self.pid = os.getpid()
         self._sinks: List[object] = []
@@ -210,7 +211,7 @@ class Tracer:
         from .sinks import JsonlSink, RingSink
         with self._lock:
             if self._ring is None:
-                cap = ring or int(os.environ.get("MRTPU_TRACE_RING", 65536))
+                cap = ring or env_knob("MRTPU_TRACE_RING", int, 65536)
                 self._ring = RingSink(cap)
                 self._sinks.append(self._ring)
             if jsonl and jsonl not in self._jsonl:
@@ -284,6 +285,9 @@ class Tracer:
         """Drop buffered ring events (sinks stay attached) — e.g. to
         separate a warmup run from the timed run."""
         if self._ring is not None:
+            # mrlint: disable=lock-unguarded-mutation — RingSink.clear
+            # takes the sink's OWN lock; self._lock only guards the
+            # _ring/_jsonl attachment maps, not ring contents
             self._ring.clear()
 
     def stats(self) -> dict:
@@ -331,7 +335,7 @@ class Tracer:
 
 def configure_from_env(tracer: Tracer) -> Tracer:
     """Apply MRTPU_TRACE (JSONL path, or '1' for ring-only) if set."""
-    path = os.environ.get("MRTPU_TRACE")
+    path = env_str("MRTPU_TRACE", None)
     if path:
         tracer.enable(jsonl=None if path == "1" else path)
     return tracer

@@ -265,6 +265,9 @@ def mark_words_pallas(words, pattern: bytes, interpret: bool = False,
     if words.dtype != jnp.int32:
         words = jax.lax.bitcast_convert_type(words, jnp.int32)
     if page_words is None:
+        # mrlint: disable=cache-key-missing-knob,purity-host-call —
+        # documented eager-fallback: cached/jitted callers pass
+        # page_words explicitly (threaded through _env_knobs keys)
         page_words = int(os.environ.get("MR_MARK_PAGE_WORDS",
                                         MARK_PAGE_WORDS))
     if m > page_words:
@@ -338,6 +341,9 @@ def compact_word_matches(wmask, nbytes: int, max_hits: int,
     mode explicitly (apps/invertedindex.py threads it through
     _env_knobs into every builder cache key)."""
     if mode is None:
+        # mrlint: disable=cache-key-missing-knob,purity-host-call —
+        # the trace-time read documented above: cached/jitted callers
+        # must pass mode explicitly (and do, via _env_knobs keys)
         mode = os.environ.get("MR_COMPACT", DEFAULT_COMPACT)
     if mode not in ("scatter", "searchsorted", "blocked"):
         # a typo'd A/B label must error, not silently measure scatter

@@ -27,7 +27,6 @@ multi-round fixed-budget variant can slot in here later (SURVEY.md §7).
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -249,9 +248,11 @@ def _dest_fn(dest, nprocs: int, mesh) -> Callable:
 # dest functions / cap tuples pinned every executable forever.  Same
 # LRU policy (and telemetry) as the plan cache; stats land in
 # MapReduce.stats()["plan"] via plan.cache.cache_stats().
-PHASE1_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
+from ..utils.env import env_knob  # noqa: E402
+
+PHASE1_CACHE = LRUCache(env_knob("MRTPU_JIT_CACHE", int, 64),
                         name="shuffle.phase1")
-PHASE2_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
+PHASE2_CACHE = LRUCache(env_knob("MRTPU_JIT_CACHE", int, 64),
                         name="shuffle.phase2")
 
 

@@ -39,7 +39,6 @@ the "ratio < 1 auto-bypass" of doc/perf.md.
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -59,7 +58,8 @@ def wire_enabled() -> bool:
     """``MRTPU_WIRE`` (default on; ``0`` = raw exchange).  Read at call
     time like the exec/ knobs so tests and the bench A/B flip it per
     run without re-importing."""
-    return os.environ.get("MRTPU_WIRE", "1") != "0"
+    from ..utils.env import env_flag
+    return env_flag("MRTPU_WIRE", True)
 
 
 def col_eligible(arr) -> bool:

@@ -25,10 +25,11 @@ flipping a knob can never replay a stale executable.
 
 from __future__ import annotations
 
-import os
 import threading
 from collections import OrderedDict
 from typing import Callable, Optional
+
+from ..utils.env import env_knob
 
 
 class LRUCache:
@@ -119,7 +120,7 @@ def plan_cache() -> LRUCache:
         with _PLAN_LOCK:
             if _PLAN_CACHE is None:
                 _PLAN_CACHE = LRUCache(
-                    int(os.environ.get("MRTPU_PLAN_CACHE", 32)),
+                    env_knob("MRTPU_PLAN_CACHE", int, 32),
                     name="plan")
     return _PLAN_CACHE
 

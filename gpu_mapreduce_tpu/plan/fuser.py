@@ -33,18 +33,18 @@ Telemetry: ``plan.execute`` / ``plan.group`` obs spans with
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field as _field
 from typing import Optional
 
 import numpy as np
 
+from ..utils.env import env_knob
 from .cache import LRUCache, plan_cache, record_history
 from .ir import Plan, PlanStage, frame_signature
 
 # bounded builder cache for the fused jitted programs (same policy as
 # the shuffle's phase caches)
-FUSED_CACHE = LRUCache(int(os.environ.get("MRTPU_JIT_CACHE", 64)),
+FUSED_CACHE = LRUCache(env_knob("MRTPU_JIT_CACHE", int, 64),
                        name="plan.fused")
 
 

@@ -40,7 +40,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..core.runtime import MRError
-from ..utils.env import env_knob
+from ..utils.env import env_flag, env_knob, env_str
 from .admission import AdmissionQueue
 from .budget import TenantBudgets
 from .session import (DONE, FAILED, QUEUED, RUNNING, Session,
@@ -85,13 +85,13 @@ class Server:
             else env_knob("MRTPU_SERVE_WORKERS", int, 2)
         cap = queue_cap if queue_cap is not None \
             else env_knob("MRTPU_SERVE_QUEUE", int, 16)
-        self.state_dir = state_dir or os.environ.get(
-            "MRTPU_SERVE_STATE") or "mrtpu-serve"
+        self.state_dir = state_dir \
+            or env_str("MRTPU_SERVE_STATE", "mrtpu-serve")
         # paused = admit + journal but do not execute (maintenance /
         # pre-drain staging; also what makes the kill-mid-queue replay
         # test deterministic)
         self.paused = paused if paused is not None \
-            else os.environ.get("MRTPU_SERVE_PAUSED", "") == "1"
+            else env_flag("MRTPU_SERVE_PAUSED", False)
         self.comm = comm
         self.queue = AdmissionQueue(cap)
         # per-tenant request-rate quota (ROADMAP item 1): 0 = off
@@ -136,6 +136,9 @@ class Server:
         global _CURRENT
         from ..ft.journal import Journal
         os.makedirs(self.state_dir, exist_ok=True)
+        # mrlint: disable=lock-unguarded-mutation — start() runs before
+        # any worker/http thread exists; shutdown's locked close is the
+        # only concurrent writer
         self._journal = Journal(self.state_dir, script_mode=True)
         self._recover()
         from ..obs import httpd, metrics
@@ -207,6 +210,8 @@ class Server:
         for r in recs:
             if r.get("kind") == "serve_submit":
                 submits.append(r)
+                # mrlint: disable=lock-unguarded-mutation — _recover
+                # runs inside start(), before the worker pool spawns
                 self._seq = max(self._seq, int(r.get("seq", 0)))
             elif r.get("kind") == "serve_done":
                 done[r.get("sid", "")] = r.get("status", DONE)
@@ -478,6 +483,10 @@ class Server:
             # journal closed — the missing done record only costs one
             # redundant (idempotent) replay on the next restart
             try:
+                # mrlint: disable=lock-unguarded-mutation — documented
+                # drain race (comment above): a closed journal costs
+                # one idempotent replay; Journal.append has its own
+                # write lock
                 self._journal.append({"kind": "serve_done",
                                       "sid": sess.sid,
                                       "status": sess.state,

@@ -36,7 +36,6 @@ stamp and verify as a no-op (back-compat).
 
 from __future__ import annotations
 
-import os
 import zlib
 from typing import Optional
 
@@ -65,7 +64,8 @@ class IntegrityError(OSError):
 def verify_enabled() -> bool:
     """The ``MRTPU_VERIFY`` knob: read-side checksum verification,
     default ON (stamping is always on — it is the cheap half)."""
-    return os.environ.get("MRTPU_VERIFY", "1") != "0"
+    from .env import env_flag
+    return env_flag("MRTPU_VERIFY", True)
 
 
 def digest_bytes(data) -> str:

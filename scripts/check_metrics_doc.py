@@ -1,89 +1,61 @@
 #!/usr/bin/env python
 """Metric-catalog lint: code and doc/observability.md must agree.
 
-Every metric name registered in ``gpu_mapreduce_tpu/`` (any lowercase
-``mrtpu_*`` string literal — the reserved namespace for metric names)
-must appear in doc/observability.md's catalog, and every ``mrtpu_*``
-name the catalog documents must still exist in code — an undocumented
-metric is invisible to operators, and a documented-but-removed one
-sends them grepping for a series that will never appear.
+THIN SHIM over mrlint's ``metric-catalog`` checker
+(``gpu_mapreduce_tpu/lint/metrics_doc.py``) — the regex logic that
+lived here moved onto the shared lint driver so the five checkers walk
+one parsed tree.  This entry point stays so ``scripts/ci.sh`` lines and
+muscle memory (``python scripts/check_metrics_doc.py``) keep working;
+same contract: exit 0 in agreement, exit 1 with the difference lists on
+stderr, no package import (fast, no side effects).
 
-Static (regex) on purpose: importing the package pulls in jax and the
-import-time metrics env hooks; a doc lint must run in milliseconds with
-no side effects.  Wired into ``scripts/ci.sh`` (quick + full).
-
-Exit 0 in agreement; exit 1 with the two difference lists otherwise.
+Prefer ``scripts/mrlint.py -r metric-catalog`` going forward.
 """
 
 from __future__ import annotations
 
+import importlib.util
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "gpu_mapreduce_tpu")
-DOC = os.path.join(REPO, "doc", "observability.md")
-
-# every lowercase mrtpu_* string literal in the package is a metric
-# name by convention (metric specs ride tuples — e.g. the ft collector
-# — so matching only counter()/gauge()/histogram() call sites would
-# miss them).  Non-metric identifiers use dashes or uppercase
-# (thread names "mrtpu-...", env vars "MRTPU_..."), which this pattern
-# excludes; a new non-metric literal that trips the lint should be
-# renamed to keep the convention machine-checkable.
-_REG_CALL = re.compile(r"[\"'](mrtpu_[a-z0-9_]+)[\"']")
-_DOC_NAME = re.compile(r"mrtpu_[a-z0-9_]+")
-
-# histogram exposition suffixes the doc may quote verbatim
-_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
-def code_metrics() -> set:
-    names = set()
-    for root, _dirs, files in os.walk(PKG):
-        if "__pycache__" in root:
-            continue
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            with open(os.path.join(root, fname)) as f:
-                names.update(_REG_CALL.findall(f.read()))
-    return names
-
-
-def doc_metrics() -> set:
-    with open(DOC) as f:
-        raw = set(_DOC_NAME.findall(f.read()))
-    out = set()
-    for name in raw:
-        for suf in _SUFFIXES:
-            if name.endswith(suf) and name[:-len(suf)] in raw:
-                break
-        else:
-            out.add(name)
-    return out
+def _load_lint():
+    """One loading recipe: reuse scripts/mrlint.py's (loaded by path so
+    the two entry points cannot drift)."""
+    spec = importlib.util.spec_from_file_location(
+        "mrlint_cli", os.path.join(REPO, "scripts", "mrlint.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+    return cli._load_lint()
 
 
 def main() -> int:
-    in_code = code_metrics()
-    in_doc = doc_metrics()
-    undocumented = sorted(in_code - in_doc)
-    stale = sorted(in_doc - in_code)
-    if not undocumented and not stale:
-        print(f"metric catalog OK: {len(in_code)} metrics, "
+    lint = _load_lint()
+    project = lint.Project(REPO)
+    findings = lint.run(project, rules=["metric-catalog"])
+    live = [f for f in findings if not f.suppressed]
+    if not live:
+        from mrlint_pkg.metrics_doc import code_metrics
+        n = len(code_metrics(project))
+        print(f"metric catalog OK: {n} metrics, "
               f"code and doc/observability.md agree")
         return 0
+    undocumented = [f for f in live if f.rule == "metric-undocumented"]
+    stale = [f for f in live if f.rule == "metric-stale"]
     if undocumented:
         print("registered in code but MISSING from "
               "doc/observability.md's catalog:", file=sys.stderr)
-        for n in undocumented:
-            print(f"  {n}", file=sys.stderr)
+        for f in undocumented:
+            # the checker carries the metric name structurally in
+            # Finding.symbol — never parse it out of the message
+            print(f"  {f.symbol}  ({f.path}:{f.line})", file=sys.stderr)
     if stale:
         print("documented in doc/observability.md but registered "
               "NOWHERE in gpu_mapreduce_tpu/:", file=sys.stderr)
-        for n in stale:
-            print(f"  {n}", file=sys.stderr)
+        for f in stale:
+            print(f"  {f.symbol}", file=sys.stderr)
     return 1
 
 

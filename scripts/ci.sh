@@ -11,6 +11,7 @@
 #                          # + full serve subset (kill-9 queue replay)
 #                          # + advisory
 #   scripts/ci.sh quick    # plan/metrics/exec/ft/serve fast subsets (~1 min)
+#   scripts/ci.sh lint     # mrlint only (all 5 rules, whole package)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -51,9 +52,21 @@ run_context_subset() {
       -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
-check_metrics_doc() {
-  echo "== metric catalog lint (code vs doc/observability.md) =="
-  python scripts/check_metrics_doc.py
+# mrlint (doc/lint.md): trace purity, lock discipline, cache-key
+# completeness, knob registry + the metric catalog (the former
+# check_metrics_doc call is folded in — metric-catalog is rule 5).
+# quick: report only files changed vs HEAD/HEAD~1 (analysis still sees
+# the whole package, so cross-module rules stay sound); full: whole
+# package, JSON + finding counts published into BASELINE.json so
+# they're trackable across PRs alongside the bench/soak records.
+run_lint_quick() {
+  echo "== mrlint (changed-module scope) =="
+  python scripts/mrlint.py --changed
+}
+
+run_lint_full() {
+  echo "== mrlint (whole package) =="
+  python scripts/mrlint.py --json mrlint.json --publish
 }
 
 run_wire_subset_quick() {
@@ -96,8 +109,13 @@ bench_compare_advisory() {
   python scripts/bench_compare.py --md - || true
 }
 
+if [ "${1:-}" = "lint" ]; then
+  run_lint_full
+  exit 0
+fi
+
 if [ "${1:-}" = "quick" ]; then
-  check_metrics_doc
+  run_lint_quick
   run_plan_subset
   run_metrics_subset
   run_exec_subset
@@ -120,7 +138,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
 [ "$rc" -eq 0 ] || exit "$rc"
 
-check_metrics_doc
+run_lint_full
 run_plan_subset
 run_metrics_subset
 run_exec_subset

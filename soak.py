@@ -89,12 +89,12 @@ def main():
     from gpu_mapreduce_tpu.core.mapreduce import MapReduce
     from gpu_mapreduce_tpu.parallel.mesh import make_mesh
 
-    scale = int(os.environ.get("SOAK_SCALE", "18"))
-    nnz = int(os.environ.get("SOAK_NNZ", "8"))
-    nmesh = int(os.environ.get("SOAK_MESH", "1"))  # VERDICT r3 #6: P>1
-    # a malformed value warns and disables the live lines instead of
-    # killing a multi-hour capture window before its first workload
-    from gpu_mapreduce_tpu.utils.env import env_knob
+    # a malformed value warns and falls back instead of killing a
+    # multi-hour capture window before its first workload
+    from gpu_mapreduce_tpu.utils.env import env_flag, env_knob, env_str
+    scale = env_knob("SOAK_SCALE", int, 18)
+    nnz = env_knob("SOAK_NNZ", int, 8)
+    nmesh = env_knob("SOAK_MESH", int, 1)  # VERDICT r3 #6: P>1
     metrics_every = env_knob("SOAK_METRICS_EVERY", int, 0)
     if "--metrics-every" in sys.argv:
         i = sys.argv.index("--metrics-every")
@@ -396,7 +396,8 @@ def main():
         # the RMAT-22 graph (VERDICT r4 #3 — the first current-code TPU
         # measurement of this row).  Separate from do_pagerank so the
         # base-scale row still lands if the big graph exhausts a window.
-        prs = int(os.environ.get("SOAK_PR_SCALE", "0"))
+        from gpu_mapreduce_tpu.utils.env import env_knob
+        prs = env_knob("SOAK_PR_SCALE", int, 0)
         if prs <= 0:
             return
         if prs == scale:
@@ -707,8 +708,8 @@ def main():
         if metrics_every and i % metrics_every == 0:
             print(metrics_line(i, name))
     if metrics_every:
-        write_final_metrics(os.environ.get("SOAK_METRICS_OUT",
-                                           "soak_metrics.json"))
+        write_final_metrics(env_str("SOAK_METRICS_OUT",
+                                    "soak_metrics.json"))
     if errors:
         published["errors"] = errors
 
@@ -734,7 +735,7 @@ def main():
     # must not erase its old row) and exits nonzero so the watcher's
     # success gate keeps retrying.
     from gpu_mapreduce_tpu.utils.publish import publish, read_published
-    if os.environ.get("SOAK_DRY") == "1":
+    if env_flag("SOAK_DRY", False):
         # smoke runs must never clobber a published full-scale row
         print("SOAK_DRY=1: not publishing", json.dumps(published))
         return
