@@ -404,7 +404,83 @@ def plan_ab_record(mode: str, comm) -> dict:
         out["plan_cache"] = plan_cache().stats()
     if len(set(results.values())) > 1:
         out["error"] = f"variant outputs disagree: {results}"
+    if mode == "ab":
+        # fusion v2: per-pipeline dispatch counts on the 8-way fake
+        # mesh (subprocess — the fake topology must not leak into the
+        # headline process); failures stay inside the sub-record
+        try:
+            out["mega"] = mega_ab_record()
+        except Exception:
+            out["mega"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     return out
+
+
+_MEGA_PROBE = r"""
+import json, os, time
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.core.runtime import global_counters
+from gpu_mapreduce_tpu.ops.reduces import count
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+mesh = make_mesh(8)
+rows = int(os.environ.get("BENCH_MEGA_ROWS", 1 << 18))
+keys = ((np.arange(rows, dtype=np.uint64) * 2654435761)
+        % max(rows // 8, 1)).astype(np.uint64)
+vals = np.ones(rows, np.int64)
+
+def pipeline():
+    mr = MapReduce(mesh, fuse=1)
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+    t0 = time.perf_counter()
+    mr.aggregate(); mr.convert()
+    n = int(mr.reduce(count, batch=True))
+    return n, time.perf_counter() - t0
+
+out = {"rows": rows}
+results = {}
+for label, flag in (("v1", "0"), ("v2", "1")):
+    os.environ["MRTPU_MEGAFUSE"] = flag
+    pipeline(); pipeline()      # compiles + arm the speculation caches
+    c0 = global_counters().snapshot()["ndispatch"]
+    n, wall = pipeline()        # steady state
+    d = global_counters().snapshot()["ndispatch"] - c0
+    results[label] = n
+    out[label] = {"wall_s": round(wall, 4), "dispatches": d,
+                  "nunique": n}
+out["outputs_equal"] = results["v1"] == results["v2"]
+out["fusion_v2_dispatches"] = out["v2"]["dispatches"]
+w1, w2 = out["v1"]["wall_s"], out["v2"]["wall_s"]
+out["group_wall_delta_pct"] = round((w2 - w1) / w1 * 100.0, 2) \
+    if w1 else 0.0
+print(json.dumps(out))
+"""
+
+
+def mega_ab_record() -> dict:
+    """Fusion-v2 A/B (``--fuse ab``): the canonical fused pipeline on
+    an 8-way fake mesh under ``MRTPU_MEGAFUSE={0,1}``, recording the
+    steady-state per-pipeline dispatch count (the "1 dispatch per plan
+    group" target, asserted via ``Counters.ndispatch``) and the
+    group-path wall delta — the advisory ``fusion_v2_dispatches`` /
+    ``group_wall_delta_pct`` rows of scripts/bench_compare.py."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    p = subprocess.run([sys.executable, "-c", _MEGA_PROBE],
+                       capture_output=True, text=True, timeout=900,
+                       env=env, cwd=os.path.dirname(
+                           os.path.abspath(__file__)))
+    if p.returncode != 0:
+        raise RuntimeError(f"megafuse probe failed: {p.stderr[-400:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
 
 
 def overlap_ab_record(mode: str, paths) -> dict:

@@ -1336,7 +1336,10 @@ class MapReduce:
         tracing is enabled (obs/) — an ``"ops"`` per-op aggregate over
         the span ring (count / total_s / byte sums per op name), plus a
         ``"plan"`` section with the compile-cache telemetry (plan cache
-        + bounded shuffle jit caches: hits/misses/evictions), plus an
+        + bounded shuffle jit caches: hits/misses/evictions) and the
+        cumulative fusion-effectiveness counters (``"fusion"``:
+        per-group fused/megafused/pallas program counts and dispatch
+        savings vs the eager baseline — doc/plan.md), plus an
         ``"exec"`` section with the async-overlap telemetry (per-path
         overlap ratios + active knobs — doc/perf.md), plus —
         when the metrics registry is armed (obs/metrics.py) — a

@@ -118,8 +118,12 @@ class Counters:
     #                         the weak-scaling "network volume" diagnosis)
     commtime: float = 0.0   # seconds in collectives
     ndispatch: int = 0      # compiled-program launches (jitted shuffle/
-    #                         convert/reduce/sort programs + fused plans)
-    #                         — what plan/ fusion is meant to shrink
+    #                         convert/reduce/sort programs, fused plans,
+    #                         AND eager pallas_call kernel launches —
+    #                         ops/pallas.note_kernel_launch) — what
+    #                         plan/ fusion is meant to shrink; a kernel
+    #                         traced inside a jit rides that program's
+    #                         count, so megafused pipelines read 1
 
     def __post_init__(self):
         import threading
@@ -301,8 +305,24 @@ def global_counters() -> Counters:
     return _GLOBAL_COUNTERS
 
 
+_DISPATCH_TLS = threading.local()
+
+
 def bump_dispatch(n: int = 1) -> None:
     """Count one compiled-program launch (the jitted shuffle/convert/
-    reduce/sort programs and fused plan programs all report here) —
-    the denominator of the plan/ fusion win (bench detail.plan_ab)."""
+    reduce/sort programs, fused plan programs AND eager pallas_call
+    kernel launches — via ops/pallas.note_kernel_launch — all report
+    here) — the denominator of the plan/ fusion win (bench
+    detail.plan_ab).  Also bumps a per-thread counter so a caller can
+    meter ITS OWN dispatches (thread_dispatches) without concurrent
+    workers contaminating the delta."""
     _GLOBAL_COUNTERS.add(ndispatch=n)
+    _DISPATCH_TLS.n = getattr(_DISPATCH_TLS, "n", 0) + n
+
+
+def thread_dispatches() -> int:
+    """Compiled-program launches made by THIS thread (cumulative).
+    Delta two reads around a region for an exact per-region count even
+    while other threads dispatch — the plan/ fusion telemetry's meter
+    (dispatches run synchronously on the calling thread)."""
+    return getattr(_DISPATCH_TLS, "n", 0)

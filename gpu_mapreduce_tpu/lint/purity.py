@@ -16,7 +16,9 @@ Entry points (the traced set's roots):
 * the callable passed to ``jax.shard_map`` / ``shard_map`` /
   ``pallas_call`` / ``pl.pallas_call`` / ``jax.jit(...)`` /
   ``donated_jit(...)`` (the repo's one donation-wrapping rule,
-  ``exec/__init__.py``).
+  ``exec/__init__.py``) — including one wrapped as
+  ``functools.partial(kernel, static_args...)``, the ops/pallas
+  call-site idiom for baking static kernel parameters.
 
 Everything reachable from an entry through the project callgraph is
 treated as traced.  Reachability is best-effort (unresolvable calls
@@ -107,6 +109,17 @@ def _entries(graph: CallGraph) -> List[FuncInfo]:
                     + (f"{scope.qual}.<lambda:{arg.lineno}>" if scope
                        else f"<lambda:{arg.lineno}>")))
                 # fall through to name-chain lookup below for non-lambda
+                continue
+            if isinstance(arg, ast.Call):
+                # functools.partial(kernel, static_args...) — the
+                # ops/pallas call-site idiom: the traced body is the
+                # partial's FIRST argument.  Without this unwrap every
+                # partial-wrapped pallas_call kernel body went unwalked.
+                fchain = name_chain(arg.func)
+                if fchain and fchain[-1] == "partial" and arg.args:
+                    achain = name_chain(arg.args[0])
+                    if achain:
+                        add(graph.resolve(mod, scope, achain))
                 continue
             achain = name_chain(arg)
             if achain:

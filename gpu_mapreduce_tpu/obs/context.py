@@ -92,7 +92,7 @@ class RequestAccount:
                  "exchange_wire_logical",
                  "spill_write", "spill_read",
                  "mem_in_use", "mem_hi_water",
-                 "retries", "plan", "stages")
+                 "retries", "plan", "fusion", "stages")
 
     def __init__(self, trace_id: Optional[str] = None,
                  tenant: str = "", label: str = ""):
@@ -116,6 +116,10 @@ class RequestAccount:
         self.mem_hi_water = 0
         self.retries: Dict[str, int] = {}
         self.plan: Dict[str, Dict[str, int]] = {}
+        self.fusion: Dict[str, int] = {
+            "groups": 0, "fused_groups": 0, "mega_groups": 0,
+            "pallas_groups": 0, "dispatches": 0,
+            "dispatches_saved": 0}
         self.stages: Dict[str, dict] = {}
 
     # -- feeds (each must never raise into the work it observes) ----------
@@ -169,6 +173,23 @@ class RequestAccount:
             if c is None:
                 c = self.plan[cache] = {"hits": 0, "misses": 0}
             c["hits" if hit else "misses"] += 1
+
+    def note_fusion(self, fused: bool, mega: bool, dispatches: int,
+                    saved: int, pallas: bool) -> None:
+        """One executed plan group charged to this request: fusion
+        effectiveness (plan/cache.note_fusion's per-request twin —
+        which classifies the kind/mode strings ONCE and hands the
+        derived booleans here)."""
+        with self._lock:
+            self.fusion["groups"] += 1
+            if fused:
+                self.fusion["fused_groups"] += 1
+                if mega:
+                    self.fusion["mega_groups"] += 1
+                if pallas:
+                    self.fusion["pallas_groups"] += 1
+            self.fusion["dispatches"] += int(dispatches)
+            self.fusion["dispatches_saved"] += int(saved)
 
     def note_span(self, name: str, cat: str, dur_s: float,
                   attrs: dict) -> None:
@@ -234,6 +255,10 @@ class RequestAccount:
                 "retries": dict(sorted(self.retries.items())),
                 "plan_cache": {c: dict(v)
                                for c, v in sorted(self.plan.items())},
+                # fusion v2 effectiveness: how many of this request's
+                # plan groups fused / megafused / took the Pallas group
+                # kernels, and the dispatches that saved vs eager
+                "fusion": dict(self.fusion),
                 "stages": dict(sorted(
                     stages.items(),
                     key=lambda kv: -kv[1]["total_s"])),
@@ -379,6 +404,16 @@ def note_plan(cache: str, hit: bool) -> None:
     acct = active_account()
     if acct is not None:
         acct.note_plan(cache, hit)
+
+
+def note_fusion(fused: bool, mega: bool, dispatches: int, saved: int,
+                pallas: bool) -> None:
+    """Feed point for plan/cache.note_fusion — per-request fusion
+    effectiveness (``profile()["fusion"]``, the serve per-request
+    profile's "did this job's pipelines megafuse" section)."""
+    acct = active_account()
+    if acct is not None:
+        acct.note_fusion(fused, mega, dispatches, saved, pallas)
 
 
 def note_span(name: str, cat: str, dur_s: float, attrs: dict) -> None:

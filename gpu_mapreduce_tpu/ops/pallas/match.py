@@ -91,7 +91,9 @@ def mark_pallas(buf, pattern: bytes, interpret: bool = False):
     """Pallas mark kernel over a uint8 buffer [n] → int8 mask [n]."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from . import note_kernel_launch
 
+    note_kernel_launch(buf)   # eager launches count as dispatches
     n = buf.shape[0]
     blk = BLOCK_ROWS * LANES
     buf_p = _pad_to(buf, blk)
@@ -289,7 +291,9 @@ def _mark_words_call(words, masks, vals, interpret: bool):
     buffer path; pages funnel through here at a fixed shape)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    from . import note_kernel_launch
 
+    note_kernel_launch(words)   # eager launches count as dispatches
     m = words.shape[0]
     blk = WORD_BLOCK_ROWS * LANES
     # one concatenate: round up to a block multiple AND append the zero

@@ -153,6 +153,49 @@ def convert_sharded(skv: ShardedKV, counters=None) -> ShardedKMV:
                       value_decode=skv.value_decode)
 
 
+def fused_group_body(k, v, nrecv, gcap: int, out_kind: str, reduce_op,
+                     pallas_cfg=None):
+    """THE fused convert(+reduce) shard-local body — composed by the
+    plan/ fuser's exchange/local/megafused programs over packed valid
+    rows.  Two interchangeable engines, byte-identical by construction:
+
+    * sort path (default): sort by key, boundary-detect, then either
+      the grouped layout (``out_kind='kmv'``) or a segment reduce to
+      one pair per group (``out_kind='kv'``) — the SAME shard-local
+      bodies the eager tier jits (`_local_sort`/`_boundary`/
+      `grouped_layout`/`segment_reduce_rows`).
+    * table path (``pallas_cfg`` set, kv + count/sum only — the fuser
+      gates support via ``ops/pallas/group.group_supported``): the
+      paged Pallas bucketed-scatter kernel accumulates per-key
+      count/sum with NO row sort, then orders only the table slots.
+
+    Returns ``(..., meta)`` where meta = [groups, nrecv, overflow]:
+    ``overflow`` is the table path's probe-exhaustion count (always 0
+    on the sort path) the megafused executor validates host-side."""
+    if pallas_cfg is not None and out_kind == "kv" \
+            and reduce_op in ("count", "sum"):
+        from ..ops.pallas.group import segment_group_reduce
+        ukey, uval, g, overflow = segment_group_reduce(
+            k, v, nrecv, gcap, reduce_op, pallas_cfg)
+        meta = jnp.stack([g, nrecv.astype(jnp.int32), overflow])
+        return ukey, uval, meta
+    sk, sv, valid = _local_sort(k, v, nrecv)
+    mask = _boundary(sk, valid)
+    ukey, sizes, voff, seg, g = grouped_layout(sk, mask, nrecv, gcap)
+    meta = jnp.stack([g, nrecv.astype(jnp.int32),
+                      jnp.zeros((), jnp.int32)])
+    if out_kind == "kmv":
+        return ukey, sizes, voff, sv, meta
+    if reduce_op == "count":
+        return ukey, sizes.astype(jnp.int64), meta
+    if reduce_op == "first":
+        uval = jnp.zeros((gcap,) + sv.shape[1:], sv.dtype).at[
+            jnp.where(mask, seg, gcap)].set(sv, mode="drop")
+        return ukey, uval, meta
+    return ukey, segment_reduce_rows(sv, seg, valid, gcap, reduce_op), \
+        meta
+
+
 # ---------------------------------------------------------------------------
 # segment reductions over a ShardedKMV (the registered-kernel reduce tier)
 # ---------------------------------------------------------------------------

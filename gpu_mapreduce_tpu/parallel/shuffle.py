@@ -93,6 +93,23 @@ def _phase1(nprocs: int, dest_of: Callable, key, value, count):
     return _phase1_core(nprocs, dest_of, key, value, count)[:3]
 
 
+def phase1_shard_body(nprocs: int, dest_of: Callable, wire_elig, k, v, c):
+    """Per-shard phase-1 body — the composable twin of
+    :func:`phase2_shard_body`: dest-sorted rows + per-dest counts, plus
+    (``wire_elig`` set) the wire codec's per-bucket min/max stats
+    computed in the SAME pass (``parallel/wire.bucket_stats``).
+    Returns ``(skey, svalue, counts_local, stats_or_None)``.  Shared by
+    the standalone phase-1 program builder and the plan/ fuser's
+    megafused single-dispatch programs, so their row layout can never
+    drift."""
+    sk, sv, cl, d = _phase1_core(nprocs, dest_of, k, v, c)
+    if wire_elig is None:
+        return sk, sv, cl, None
+    from .wire import bucket_stats
+    k_elig, v_elig = wire_elig
+    return sk, sv, cl, bucket_stats(nprocs, k, v, d, k_elig, v_elig)
+
+
 def _build_send_window(nprocs: int, B: int, start: int, rows,
                        counts_local):
     """Scatter dest-sorted rows into a [P, B, ...] send buffer, taking
@@ -286,16 +303,11 @@ def _phase1_build(mesh, dest, donate: bool = False, wire=None):
 
     if wire is None:
         def body(k, v, c):
-            return _phase1(nprocs, dest_of, k, v, c)
+            return phase1_shard_body(nprocs, dest_of, None, k, v, c)[:3]
         nouts = 3
     else:
-        from .wire import bucket_stats
-        k_elig, v_elig = wire
-
         def body(k, v, c):
-            sk, sv, cl, d = _phase1_core(nprocs, dest_of, k, v, c)
-            return sk, sv, cl, bucket_stats(nprocs, k, v, d,
-                                            k_elig, v_elig)
+            return phase1_shard_body(nprocs, dest_of, wire, k, v, c)
         nouts = 4
 
     def phase1(key, value, count):

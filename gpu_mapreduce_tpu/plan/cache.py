@@ -127,13 +127,73 @@ def plan_cache() -> LRUCache:
 
 def cache_stats() -> dict:
     """Structured snapshot of every bounded compile cache — the plan
-    cache plus the shuffle's phase1/phase2 jit caches (what
+    cache plus the shuffle's phase1/phase2 jit caches — and the
+    cumulative fusion-effectiveness counters (what
     ``MapReduce.stats()['plan']`` reports)."""
     out = {"plan": plan_cache().stats()}
     from ..parallel import shuffle
     out["shuffle_phase1"] = shuffle.PHASE1_CACHE.stats()
     out["shuffle_phase2"] = shuffle.PHASE2_CACHE.stats()
+    out["fusion"] = fusion_stats()
     return out
+
+
+# ---------------------------------------------------------------------------
+# fusion effectiveness: per-group fused program counts + dispatch
+# savings (fusion v2, plan/fuser.py) — the "did megafusion actually
+# shrink dispatches" half of mr.stats()["plan"], next to the cache
+# hit/miss half above.  Also fed per-request into the active
+# RequestAccount so GET /v1/jobs/<id>/profile shows it per job.
+# ---------------------------------------------------------------------------
+
+_FUSION_LOCK = threading.Lock()
+_FUSION = {"groups": 0, "fused_groups": 0, "eager_groups": 0,
+           "mega_groups": 0, "pallas_groups": 0, "dispatches": 0,
+           "eager_dispatch_estimate": 0, "dispatches_saved": 0}
+
+
+def note_fusion(kind: str, mode: str, dispatches: int, eager_est: int,
+                pallas: bool = False) -> None:
+    """One executed plan group: its fusion kind ("exchange"/"local"/
+    "eager"), execution mode ("mega"/"local1" = single-dispatch warm,
+    "v1"/"local" = cold or fallback, "eager" = replay), the compiled-
+    program launches it actually made, and the eager tier's per-op
+    baseline for the same stages."""
+    # classify ONCE; the per-request twin (obs/context) receives the
+    # derived booleans so the mode-string sets can never drift
+    fused = kind != "eager"
+    mega = fused and mode in ("mega", "local1")
+    saved = max(0, int(eager_est) - int(dispatches)) if fused else 0
+    with _FUSION_LOCK:
+        _FUSION["groups"] += 1
+        if not fused:
+            _FUSION["eager_groups"] += 1
+        else:
+            _FUSION["fused_groups"] += 1
+            if mega:
+                _FUSION["mega_groups"] += 1
+            if pallas:
+                _FUSION["pallas_groups"] += 1
+        _FUSION["dispatches"] += int(dispatches)
+        _FUSION["eager_dispatch_estimate"] += int(eager_est)
+        _FUSION["dispatches_saved"] += saved
+    try:
+        from ..obs.context import note_fusion as _ctx_note
+        _ctx_note(fused, mega, int(dispatches), saved, pallas)
+    except Exception:
+        pass
+
+
+def fusion_stats() -> dict:
+    with _FUSION_LOCK:
+        return dict(_FUSION)
+
+
+def reset_fusion_stats() -> None:
+    """Test/bench isolation: zero the cumulative fusion counters."""
+    with _FUSION_LOCK:
+        for k in _FUSION:
+            _FUSION[k] = 0
 
 
 def stats_delta(before: dict, after: Optional[dict] = None) -> dict:

@@ -15,6 +15,25 @@ greedily groups maximal fusible runs:
 * ``[convert, reduce(kernel, batch)]`` on an already-sharded KV
   → ONE fused local program (no exchange).
 
+**Megafusion** (``MRTPU_MEGAFUSE``, default on — fusion v2,
+doc/plan.md): on a *warm* group (the CompiledPlan carries the previous
+run's exchange plan and group capacity) the remaining fusion boundary —
+the host count/stats sync between phase 1 and the fused program — moves
+OFF the dispatch path: ONE jit/``shard_map`` program composes phase-1
+dest-sort + wire-encode + exchange + wire-decode + group/segment-reduce
+and *additionally emits* the count/stats/meta matrices, which the host
+pulls AFTER the single dispatch as a speculation check (``plan_holds``
++ group-capacity coverage + kernel-overflow count).  A failed check
+discards the result and re-runs the two-dispatch v1 path on the same
+inputs (megafused programs never donate, precisely so this replay and
+the chaos retry stay possible).  Steady state: **1 dispatch per plan
+group** (``Counters.ndispatch``, the bench ``detail.plan_ab`` target).
+Inside the megafused program, supported group chains (kv out, count/sum
+reduce, ≤8-byte integer columns) replace the per-shard ``lexsort``
+grouping with the paged Pallas table kernels of ``ops/pallas/group.py``
+(``MRTPU_PALLAS_GROUP``); unsupported chains warn once and keep the
+sort path — still fused, still byte-identical.
+
 Everything else — host-callback tiers, serial backend, spill/out-of-core
 datasets, over-HBM-budget datasets, comparator sorts — **breaks fusion**:
 those stages replay through the ordinary eager methods, so every
@@ -38,8 +57,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..utils.env import env_knob
-from .cache import LRUCache, plan_cache, record_history
+from ..utils.env import env_flag, env_knob
+from .cache import LRUCache, note_fusion, plan_cache, record_history
 from .ir import Plan, PlanStage, frame_signature
 
 # bounded builder cache for the fused jitted programs (same policy as
@@ -48,12 +67,30 @@ FUSED_CACHE = LRUCache(env_knob("MRTPU_JIT_CACHE", int, 64),
                        name="plan.fused")
 
 
+def megafuse_enabled() -> bool:
+    """``MRTPU_MEGAFUSE`` (default on): single-dispatch warm groups —
+    fusion v2.  ``0`` restores the v1 two-dispatch fuser everywhere
+    (the auto-fallback target; the A/B knob of bench ``--fuse ab``)."""
+    return env_flag("MRTPU_MEGAFUSE", True)
+
+
+# eager-tier compiled-program launches per op (shuffle phase 1+2,
+# convert phase 1+2, one segment-reduce program) — the baseline the
+# fusion-savings telemetry in mr.stats()["plan"]["fusion"] compares
+# actual group dispatches against (doc/plan.md "reading the counters")
+_EAGER_DISPATCHES = {"aggregate": 2, "convert": 2, "reduce": 1}
+
+
 @dataclass
 class CompiledPlan:
     """Cached executable state of one (fingerprint, shapes) plan: the
     group structure last used plus per-group exchange caps for reuse."""
     groups: list = _field(default_factory=list)   # descriptions (history)
     caps: dict = _field(default_factory=dict)     # group idx → (B, R, cap)
+    # fusion v2: per-group megafuse speculation state, recorded by a
+    # successful v1 run and validated after every single-dispatch run —
+    # gidx → ("x", exchange_plan, gcap) | ("l", gcap)
+    mega: dict = _field(default_factory=dict)
     runs: int = 0
 
 
@@ -167,33 +204,37 @@ def _reduce_value_ok(frame, rop: str) -> bool:
 # ---------------------------------------------------------------------------
 # fused program bodies (composable, shard-local)
 # ---------------------------------------------------------------------------
+# The convert(+reduce) shard body itself lives with its eager siblings
+# in ``parallel/group.fused_group_body`` (sort path + the Pallas table
+# path); the builders here only choose its static knobs and compose it
+# with the exchange bodies.
 
-def _group_reduce_body(k, v, nrecv, gcap: int, out_kind: str,
-                       reduce_op: Optional[str]):
-    """Shard-local convert(+reduce) over packed valid rows: sort by key,
-    boundary-detect groups, then either emit the grouped layout
-    (out_kind='kmv') or segment-reduce to one pair per group
-    (out_kind='kv').  Composes the SAME shard-local bodies the eager
-    tier jits — `parallel/group`'s `_local_sort`/`_boundary`/
-    `grouped_layout`/`segment_reduce_rows` — so fused output is
-    byte-identical to the eager path by construction."""
-    import jax.numpy as jnp
-    from ..parallel.group import (_boundary, _local_sort, grouped_layout,
-                                  segment_reduce_rows)
 
-    sk, sv, valid = _local_sort(k, v, nrecv)
-    mask = _boundary(sk, valid)
-    ukey, sizes, voff, seg, g = grouped_layout(sk, mask, nrecv, gcap)
-    meta = jnp.stack([g, nrecv.astype(jnp.int32)])
-    if out_kind == "kmv":
-        return ukey, sizes, voff, sv, meta
-    if reduce_op == "count":
-        return ukey, sizes.astype(jnp.int64), meta
-    if reduce_op == "first":
-        uval = jnp.zeros((gcap,) + sv.shape[1:], sv.dtype).at[
-            jnp.where(mask, seg, gcap)].set(sv, mode="drop")
-        return ukey, uval, meta
-    return ukey, segment_reduce_rows(sv, seg, valid, gcap, reduce_op), meta
+def _pallas_cfg_for(mr, skv, cap: int, out_kind: str, reduce_op,
+                    gcap: int):
+    """The hashable kernel config threaded into the builder cache keys,
+    or None → sort path.  None when the knob is off or the chain is
+    unsupported (``ops/pallas/group.group_supported`` — warn once)."""
+    from ..ops.pallas import group as pgroup
+    if not pgroup.pallas_group_enabled():
+        return None
+    ok, reason = pgroup.group_supported(skv.key, skv.value, out_kind,
+                                        reduce_op)
+    if not ok:
+        pgroup.warn_fallback(reason)
+        return None
+    import jax
+    return ("tbl", pgroup.table_slots(gcap),
+            pgroup.page_rows_for(cap, mr.settings.memsize),
+            jax.default_backend() != "tpu")
+
+
+def _gcap_for(gcounts, cap_out: int) -> int:
+    """The group capacity a warm megafused run compiles at: the eager
+    tier's pow2 residency bound (``round_cap`` of the observed max),
+    clamped to the exchange output capacity."""
+    from ..parallel.sharded import round_cap
+    return min(round_cap(max(int(gcounts.max()), 1)), cap_out)
 
 
 def _donate_argnums(donate: bool, aliasable_dim0: bool, out_kind: str,
@@ -229,6 +270,7 @@ def _fused_exchange_build(mesh, transport, plan, out_kind,
                           reduce_op, donate_argnums=()):
     import jax
     from ..exec import donated_jit
+    from ..parallel.group import fused_group_body
     from ..parallel.mesh import mesh_axis_size, row_spec
     from ..parallel.shuffle import phase2_shard_body
     from ..parallel.wire import phase2_wire_shard_body, plan_cap_out
@@ -245,8 +287,8 @@ def _fused_exchange_build(mesh, transport, plan, out_kind,
                 out_k, out_v, nrecv = phase2_wire_shard_body(
                     nprocs, transport, mesh, tiers, cap_out, kpack,
                     vpack, k, v, cl, st)
-                return _group_reduce_body(out_k, out_v, nrecv, cap_out,
-                                          out_kind, reduce_op)
+                return fused_group_body(out_k, out_v, nrecv, cap_out,
+                                        out_kind, reduce_op)
             return jax.shard_map(
                 body, mesh=mesh, in_specs=(spec,) * 4,
                 out_specs=(spec,) * nouts)(skey, svalue, counts_local,
@@ -259,8 +301,8 @@ def _fused_exchange_build(mesh, transport, plan, out_kind,
                 out_k, out_v, nrecv = phase2_shard_body(
                     nprocs, transport, mesh, B, nrounds, cap_out, k, v,
                     cl)
-                return _group_reduce_body(out_k, out_v, nrecv, cap_out,
-                                          out_kind, reduce_op)
+                return fused_group_body(out_k, out_v, nrecv, cap_out,
+                                        out_kind, reduce_op)
             return jax.shard_map(
                 body, mesh=mesh, in_specs=(spec, spec, spec),
                 out_specs=(spec,) * nouts)(skey, svalue, counts_local)
@@ -268,6 +310,66 @@ def _fused_exchange_build(mesh, transport, plan, out_kind,
     # exec/: the dest-sorted phase-1 intermediates are dead after the
     # fused program — donate the aliasable ones (MRTPU_DONATE)
     return donated_jit(run, donate_argnums)
+
+
+def _mega_jit(mesh, transport: int, dest, plan, gcap: int,
+              out_kind: str, reduce_op, elig, pallas_cfg):
+    """The fusion-v2 single-dispatch program: phase-1 dest-sort (+wire
+    stats) + exchange (+wire encode/decode) + group/segment-reduce in
+    ONE jit/shard_map, with the count/stats/meta matrices as extra
+    outputs the host pulls AFTER dispatch (the speculation check).
+    Every static knob — the exchange plan, the group capacity, the
+    kernel config — keys the executable cache."""
+    key = ("mega", mesh, transport, dest, plan, gcap, out_kind,
+           reduce_op, elig, pallas_cfg)
+    return FUSED_CACHE.get_or_build(
+        key, lambda: _mega_build(mesh, transport, dest, plan, gcap,
+                                 out_kind, reduce_op, elig, pallas_cfg))
+
+
+def _mega_build(mesh, transport, dest, plan, gcap, out_kind, reduce_op,
+                elig, pallas_cfg):
+    import jax
+    from ..parallel.group import fused_group_body
+    from ..parallel.mesh import (mesh_axis_size, row_spec,
+                                 shard_map_kernels)
+    from ..parallel.shuffle import (_dest_fn, phase1_shard_body,
+                                    phase2_shard_body)
+    from ..parallel.wire import phase2_wire_shard_body, plan_cap_out
+    nprocs = mesh_axis_size(mesh)
+    spec = row_spec(mesh)
+    dest_of = _dest_fn(dest, nprocs, mesh)
+    cap_out = plan_cap_out(plan)
+    ngout = 5 if out_kind == "kmv" else 3
+    nouts = ngout + 1 + (1 if elig is not None else 0)
+
+    def body(k, v, c):
+        sk, sv, cl, st = phase1_shard_body(nprocs, dest_of, elig, k, v, c)
+        if plan[0] == "wire":
+            _tag, tiers, _cap, kpack, vpack = plan
+            out_k, out_v, nrecv = phase2_wire_shard_body(
+                nprocs, transport, mesh, tiers, cap_out, kpack, vpack,
+                sk, sv, cl, st)
+        else:
+            _tag, B, nrounds, _cap = plan
+            out_k, out_v, nrecv = phase2_shard_body(
+                nprocs, transport, mesh, B, nrounds, cap_out, sk, sv, cl)
+        gouts = fused_group_body(out_k, out_v, nrecv, gcap, out_kind,
+                                 reduce_op, pallas_cfg)
+        return (*gouts, cl) if st is None else (*gouts, cl, st)
+
+    def run(key, value, count):
+        if pallas_cfg is not None:
+            sm = shard_map_kernels(body, mesh, (spec,) * 3,
+                                   (spec,) * nouts)
+        else:
+            sm = jax.shard_map(body, mesh=mesh, in_specs=(spec,) * 3,
+                               out_specs=(spec,) * nouts)
+        return sm(key, value, count)
+
+    # NEVER donated: a failed speculation check (or a chaos retry)
+    # re-runs on the same inputs, which donation would have deleted
+    return jax.jit(run)
 
 
 def _compact_jit(mesh, n: int, narrs: int):
@@ -306,27 +408,40 @@ def _maybe_compact(mesh, gcap: int, gcounts, *arrs):
 
 
 def _fused_local_jit(mesh, out_kind: str, reduce_op: Optional[str],
+                     gcap: Optional[int] = None, pallas_cfg=None,
                      donate_argnums=()):
-    key = ("local", mesh, out_kind, reduce_op, tuple(donate_argnums))
+    key = ("local", mesh, out_kind, reduce_op, gcap, pallas_cfg,
+           tuple(donate_argnums))
     return FUSED_CACHE.get_or_build(
         key, lambda: _fused_local_build(mesh, out_kind, reduce_op,
+                                        gcap, pallas_cfg,
                                         donate_argnums))
 
 
-def _fused_local_build(mesh, out_kind, reduce_op, donate_argnums=()):
+def _fused_local_build(mesh, out_kind, reduce_op, gcap=None,
+                       pallas_cfg=None, donate_argnums=()):
     import jax
     from ..exec import donated_jit
-    from ..parallel.mesh import row_spec
+    from ..parallel.group import fused_group_body
+    from ..parallel.mesh import row_spec, shard_map_kernels
     spec = row_spec(mesh)
     nouts = 5 if out_kind == "kmv" else 3
 
     def run(key, value, counts):
         def body(k, v, c):
-            return _group_reduce_body(k, v, c[0], k.shape[0], out_kind,
-                                      reduce_op)
-        return jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec),
-            out_specs=(spec,) * nouts)(key, value, counts)
+            # gcap=None → full row capacity (the cold run); a warm run
+            # compiles at the cached compact capacity (fusion v2)
+            return fused_group_body(k, v, c[0],
+                                    k.shape[0] if gcap is None else gcap,
+                                    out_kind, reduce_op, pallas_cfg)
+        if pallas_cfg is not None:
+            sm = shard_map_kernels(body, mesh, (spec, spec, spec),
+                                   (spec,) * nouts)
+        else:
+            sm = jax.shard_map(
+                body, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=(spec,) * nouts)
+        return sm(key, value, counts)
 
     # exec/: the consumed KV is replaced by the grouped output right
     # after (_install_kv) — donating lets the group layout reuse its
@@ -377,19 +492,52 @@ def _install_kmv(mr, skmv):
 
 
 def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
-                         gidx: int, sp, frame):
-    """Run [aggregate, convert(, reduce)] as phase1 + ONE fused program.
-    Under ``MRTPU_WIRE`` the fused program is the wire-codec variant
+                         gidx: int, sp, frame) -> tuple:
+    """Run [aggregate, convert(, reduce)] as a fused exchange group.
+    Warm + ``MRTPU_MEGAFUSE``: ONE megafused program (see module doc);
+    cold or speculation-failed: phase 1 + ONE fused program (v1).
+    Under ``MRTPU_WIRE`` both compose the wire-codec bodies
     (parallel/wire.py): the rows cross the interconnect delta-packed
     with tiered caps and decode inside the same program, so the grouped
-    output stays byte-identical to the eager tiers."""
+    output stays byte-identical to the eager tiers.
+
+    Runs under the ft/ ``shuffle.exchange`` fault site + retry policy
+    like the eager exchange: the fault point sits before any dispatch,
+    and a failure after the v1 path's donated phase-1 dispatch is
+    vetoed as non-retryable (the megafused program never donates, so
+    its retries are always safe).  Returns ``(mode, pallas)`` for the
+    fusion telemetry."""
+    from ..ft.inject import fault_point
+    from ..ft.retry import retry_call
+    from ..parallel.mesh import mesh_axis_size
+
+    skv = _as_sharded(mr, frame)
+
+    def _once():
+        fault_point("shuffle.exchange")
+        return _exchange_group_impl(mr, stages, reduce_op, compiled,
+                                    gidx, sp, skv)
+
+    def _retryable(e):
+        try:
+            return not skv.key.is_deleted()
+        except Exception:
+            return False
+
+    return retry_call(
+        "shuffle.exchange", _once,
+        detail=f"P={mesh_axis_size(mr.backend.mesh)} fused",
+        retryable=_retryable)
+
+
+def _exchange_group_impl(mr, stages, reduce_op, compiled, gidx, sp,
+                         skv) -> tuple:
     import jax
     from ..core.runtime import Timer, bump_dispatch
     from ..parallel import wire as _wire
     from ..parallel.mesh import mesh_axis_size, row_sharding
-    from ..parallel.sharded import ShardedKMV, ShardedKV, SyncStats
-    from ..parallel.shuffle import (ExchangeCallStats, ExchangeStats,
-                                    _phase1_jit)
+    from ..parallel.sharded import SyncStats
+    from ..parallel.shuffle import _phase1_jit
 
     mesh = mr.backend.mesh
     nprocs = mesh_axis_size(mesh)
@@ -398,7 +546,6 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
     _ok, hash_fn = _agg_hash(stages[0])
     dest = ("hash", hash_fn)
 
-    skv = _as_sharded(mr, frame)
     from ..exec import can_donate
     donate = can_donate(skv)
     wire_on = _wire.wire_enabled()
@@ -406,6 +553,18 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
     t = Timer()
+
+    entry = compiled.mega.get(gidx) if megafuse_enabled() else None
+    if entry is not None and entry[0] == "x":
+        pallas = _exec_mega_exchange(mr, stages, reduce_op, compiled,
+                                     gidx, sp, skv, dest, out_kind,
+                                     entry, wire_on, elig, counts_dev, t)
+        if pallas is not None:
+            return "mega", pallas
+        # speculation failed — discard and fall through to v1 on the
+        # SAME (never-donated) inputs; the commtime Timer keeps running
+        # so the failed attempt's wall is charged honestly
+
     bump_dispatch()
     stats_local = None
     if wire_on:
@@ -446,28 +605,106 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
         out = fused(skey, svalue, counts_local, stats_local)
     else:
         out = fused(skey, svalue, counts_local)
-    meta = np.asarray(out[-1]).reshape(nprocs, 2)
+    meta = np.asarray(out[-1]).reshape(nprocs, 3)
     gcounts = meta[:, 0].astype(np.int32)
     vcounts = meta[:, 1].astype(np.int32)
+    _finish_exchange_group(mr, stages, sp, skv, out_kind, reduce_op,
+                           mesh, nprocs, plan, counts_mat, gcounts,
+                           vcounts, out, t, compact_from=cap_out)
+    # arm the NEXT run's single-dispatch speculation with what this run
+    # measured: the plan that ran and the compact group capacity
+    if megafuse_enabled():
+        compiled.mega[gidx] = ("x", plan, _gcap_for(gcounts, cap_out))
+    return "v1", False
+
+
+def _exec_mega_exchange(mr, stages, reduce_op, compiled, gidx, sp, skv,
+                        dest, out_kind, entry, wire_on, elig,
+                        counts_dev, t):
+    """One megafused attempt.  Returns the pallas flag on success, or
+    None when the post-dispatch speculation check failed (the caller
+    re-runs v1 on the same inputs — nothing was donated)."""
+    from ..core.runtime import bump_dispatch
+    from ..parallel import wire as _wire
+    from ..parallel.mesh import mesh_axis_size
+    from ..parallel.sharded import SyncStats
+
+    mesh = mr.backend.mesh
+    nprocs = mesh_axis_size(mesh)
+    transport = mr.settings.all2all
+    _tag, plan, gcap = entry
+    pallas_cfg = _pallas_cfg_for(mr, skv, _wire.plan_cap_out(plan),
+                                 out_kind, reduce_op, gcap)
+    bump_dispatch()   # THE one dispatch of the warm group
+    prog = _mega_jit(mesh, transport, dest, plan, gcap, out_kind,
+                     reduce_op, elig, pallas_cfg)
+    out = prog(skv.key, skv.value, counts_dev)
+    SyncStats.bump()   # still ONE host round-trip — now after dispatch
+    ngout = 5 if out_kind == "kmv" else 3
+    gouts = out[:ngout]
+    counts_mat = np.asarray(out[ngout]).reshape(nprocs, nprocs)
+    stats_mat = (np.asarray(out[ngout + 1]).reshape(nprocs, nprocs, 4)
+                 if elig is not None else None)
+    meta = np.asarray(gouts[-1]).reshape(nprocs, 3)
+    gcounts = meta[:, 0].astype(np.int32)
+    vcounts = meta[:, 1].astype(np.int32)
+    overflow = int(meta[:, 2].sum())
+    # the speculation check: would the compiled shapes have dropped any
+    # row (exchange plan) or group (gcap / kernel table overflow)?
+    fresh, kvrange, bmax_raw, nmax_out, _nc = _wire.plan_from_pull(
+        skv.key, skv.value, counts_mat, stats_mat, wire_on, elig)
+    max_g = int(gcounts.max()) if gcounts.size else 0
+    if (overflow or max_g > gcap
+            or not _wire.plan_holds(plan, bmax_raw, nmax_out, kvrange)):
+        compiled.mega.pop(gidx, None)
+        sp.set(mega_miss=True)
+        return None
+    # right-size a grossly oversized or tag-shifted entry for NEXT time
+    # (this run's result is exact and kept)
+    if (plan[0] != fresh[0]
+            or _wire.plan_oversized(plan, bmax_raw, nmax_out)
+            or gcap > 4 * _gcap_for(gcounts, _wire.plan_cap_out(plan))):
+        compiled.mega[gidx] = (
+            "x", fresh, _gcap_for(gcounts, _wire.plan_cap_out(fresh)))
+    _finish_exchange_group(mr, stages, sp, skv, out_kind, reduce_op,
+                           mesh, nprocs, plan, counts_mat, gcounts,
+                           vcounts, gouts, t, compact_from=None,
+                           mega=True, pallas=pallas_cfg is not None)
+    return pallas_cfg is not None
+
+
+def _finish_exchange_group(mr, stages, sp, skv, out_kind, reduce_op,
+                           mesh, nprocs, plan, counts_mat, gcounts,
+                           vcounts, out, t, compact_from=None,
+                           mega=False, pallas=False):
+    """Shared tail of the v1 and megafused exchange groups: byte/stat
+    accounting, span attrs, stage results and dataset installation —
+    ONE copy so the two tiers' telemetry can never diverge."""
+    from ..parallel import wire as _wire
+    from ..parallel.sharded import ShardedKMV, ShardedKV
+    from ..parallel.shuffle import ExchangeCallStats, ExchangeStats
+
     mr.counters.add(commtime=t.elapsed())
     nrows = int(counts_mat.sum())
     ngroups = int(gcounts.sum())
-    # exchange byte accounting + per-call stats, like the eager exchange
+    cap_out = _wire.plan_cap_out(plan)
     B_eff, nrounds_eff = _wire.plan_rounds(plan)
     stats = ExchangeCallStats(nrounds=nrounds_eff, bucket=B_eff,
                               cap_out=cap_out, rows=nrows,
-                              speculative=False)
+                              speculative=mega)
     _account_exchange(mr, skv, counts_mat, plan, nprocs, stats)
     ExchangeStats.last = (nrounds_eff, B_eff)   # deprecated shim
     mr.last_exchange = stats
     sp.set(bucket=B_eff, nrounds=nrounds_eff, cap_out=cap_out,
            rows=nrows, groups=ngroups, wire_bytes=stats.wire_bytes,
-           wire_ratio=stats.wire_ratio)
+           wire_ratio=stats.wire_ratio, mega=mega, pallas=pallas)
     stages[0].result = nrows
     stages[1].result = ngroups
     if out_kind == "kv":
-        ukey, uval, _meta = out
-        ukey, uval = _maybe_compact(mesh, cap_out, gcounts, ukey, uval)
+        ukey, uval = out[0], out[1]
+        if compact_from is not None:
+            ukey, uval = _maybe_compact(mesh, compact_from, gcounts,
+                                        ukey, uval)
         skv_out = ShardedKV(mesh, ukey, uval, gcounts,
                             key_decode=skv.key_decode)
         if reduce_op == "first":
@@ -477,10 +714,12 @@ def _exec_exchange_group(mr, stages, reduce_op, compiled: CompiledPlan,
     else:
         # values/voff stay row-capacity-sized (voff indexes value rows,
         # exactly like the eager ShardedKMV); only group-indexed arrays
-        # compact
-        ukey, sizes, voff, values, _meta = out
-        ukey, sizes, voff = _maybe_compact(mesh, cap_out, gcounts,
-                                           ukey, sizes, voff)
+        # compact (already compiled compact in the megafused program)
+        ukey, sizes, voff, values = out[0], out[1], out[2], out[3]
+        if compact_from is not None:
+            ukey, sizes, voff = _maybe_compact(mesh, compact_from,
+                                               gcounts, ukey, sizes,
+                                               voff)
         skmv = ShardedKMV(mesh, ukey, sizes, voff, values, gcounts,
                           vcounts, key_decode=skv.key_decode,
                           value_decode=skv.value_decode)
@@ -503,8 +742,14 @@ def _account_exchange(mr, skv, counts_mat, plan, nprocs, stats):
     record_exchange(stats)
 
 
-def _exec_local_group(mr, stages, reduce_op, sp, frame):
-    """Run [convert, reduce(kernel)] on a ShardedKV as ONE program."""
+def _exec_local_group(mr, stages, reduce_op, compiled: CompiledPlan,
+                      gidx: int, sp, frame) -> tuple:
+    """Run [convert, reduce(kernel)] on a ShardedKV as ONE program.
+    Fusion v2: a warm group compiles at the cached compact group
+    capacity (skipping the separate compact dispatch) and may take the
+    Pallas table path; the post-dispatch meta pull validates the
+    capacity and re-runs at full capacity when it no longer covers.
+    Returns ``(mode, pallas)`` for the fusion telemetry."""
     import jax
     from ..core.runtime import bump_dispatch
     from ..parallel.mesh import mesh_axis_size, row_sharding
@@ -518,23 +763,58 @@ def _exec_local_group(mr, stages, reduce_op, sp, frame):
     cap = skv.key.shape[0] // nprocs   # before donation deletes the data
     counts_dev = jax.device_put(skv.counts.astype(np.int32),
                                 row_sharding(mesh))
+    entry = compiled.mega.get(gidx) if megafuse_enabled() else None
+    gcap = entry[1] if entry is not None and entry[0] == "l" else None
+    pallas_cfg = None
+    if gcap is not None:
+        pallas_cfg = _pallas_cfg_for(mr, skv, cap, "kv", reduce_op,
+                                     gcap)
+    mode = "local1" if gcap is not None else "local"
     bump_dispatch()
-    argnums = _donate_argnums(donate, True, "kv", reduce_op, skv.value)
+    # donation only when the group outputs alias the inputs byte for
+    # byte — a compact (gcap < cap) warm program's outputs are smaller,
+    # and its speculative re-run needs the inputs alive anyway
+    argnums = _donate_argnums(donate and gcap is None, True, "kv",
+                              reduce_op, skv.value)
     ukey, uval, meta = _fused_local_jit(mesh, "kv", reduce_op,
+                                        gcap=gcap,
+                                        pallas_cfg=pallas_cfg,
                                         donate_argnums=argnums)(
         skv.key, skv.value, counts_dev)
     SyncStats.bump()
-    gcounts = np.asarray(meta).reshape(nprocs, 2)[:, 0].astype(np.int32)
+    m = np.asarray(meta).reshape(nprocs, 3)
+    gcounts = m[:, 0].astype(np.int32)
+    overflow = int(m[:, 2].sum())
+    if gcap is not None and (overflow or int(gcounts.max()) > gcap):
+        # the cached capacity no longer covers: discard and re-run at
+        # full row capacity (nothing was donated on the compact path)
+        compiled.mega.pop(gidx, None)
+        sp.set(mega_miss=True)
+        bump_dispatch()
+        ukey, uval, meta = _fused_local_jit(
+            mesh, "kv", reduce_op, donate_argnums=())(
+            skv.key, skv.value, counts_dev)
+        SyncStats.bump()   # the re-run's meta pull is a second sync
+        m = np.asarray(meta).reshape(nprocs, 3)
+        gcounts = m[:, 0].astype(np.int32)
+        gcap = None
+        mode = "local"
+        pallas_cfg = None
     ngroups = int(gcounts.sum())
-    ukey, uval = _maybe_compact(mesh, cap, gcounts, ukey, uval)
+    if gcap is None:
+        ukey, uval = _maybe_compact(mesh, cap, gcounts, ukey, uval)
+        if megafuse_enabled():
+            compiled.mega[gidx] = ("l", _gcap_for(gcounts, cap))
     skv_out = ShardedKV(mesh, ukey, uval, gcounts,
                         key_decode=skv.key_decode)
     if reduce_op == "first":
         skv_out.value_decode = skv.value_decode
     _install_kv(mr, skv_out)
-    sp.set(groups=ngroups)
+    sp.set(groups=ngroups, mega=gcap is not None,
+           pallas=pallas_cfg is not None)
     stages[0].result = ngroups
     stages[1].result = ngroups
+    return mode, pallas_cfg is not None
 
 
 def _replay(mr, stage: PlanStage):
@@ -585,6 +865,7 @@ def execute_plan(mr, plan: Plan) -> None:
     groups_desc = []
     with tracer.span("plan.execute", cat="plan", nstages=len(plan),
                      cache_hit=cache_hit) as psp:
+        from ..core.runtime import thread_dispatches
         stages = list(plan.stages)
         i = 0
         gidx = 0
@@ -595,6 +876,10 @@ def execute_plan(mr, plan: Plan) -> None:
                     "fused": kind is not None, "kind": kind or "eager",
                     "reduce_op": rop}
             groups_desc.append(desc)
+            # per-THREAD meter: concurrent serve workers' dispatches
+            # must not contaminate this group's count (review fix)
+            d0 = thread_dispatches()
+            mode, pallas = "eager", False
             if kind is None:
                 _replay(mr, run[0])
             else:
@@ -603,10 +888,11 @@ def execute_plan(mr, plan: Plan) -> None:
                                  reduce_op=rop or "") as sp:
                     try:
                         if kind == "exchange":
-                            _exec_exchange_group(mr, run, rop, compiled,
-                                                 gidx, sp, frame)
+                            mode, pallas = _exec_exchange_group(
+                                mr, run, rop, compiled, gidx, sp, frame)
                         else:
-                            _exec_local_group(mr, run, rop, sp, frame)
+                            mode, pallas = _exec_local_group(
+                                mr, run, rop, compiled, gidx, sp, frame)
                     except BaseException:
                         # same contract as the eager exchange callers:
                         # a failure after a donated dispatch must leave
@@ -617,6 +903,14 @@ def execute_plan(mr, plan: Plan) -> None:
                         if kv is not None:
                             free_if_donated(kv, frame)
                         raise
+            # fusion effectiveness telemetry (mr.stats()["plan"]
+            # ["fusion"] + the per-request profile): actual dispatches
+            # of this group vs the eager tier's known per-op counts
+            note_fusion(
+                kind or "eager", mode, thread_dispatches() - d0,
+                sum(_EAGER_DISPATCHES.get(s.op, 1) for s in run),
+                pallas=pallas)
+            desc["mode"] = mode
             i += n
             gidx += 1
         psp.set(ngroups=gidx,

@@ -81,6 +81,14 @@ ADVISORY_METRICS = (
     ("wire_compression_ratio", +1),
     ("wire_intcount_sec", -1),
     ("wire_wall_delta_pct", -1),
+    # fusion-v2 rows (bench.py --fuse ab, detail.plan_ab.mega): the
+    # steady-state per-pipeline dispatch count under MRTPU_MEGAFUSE=1
+    # (target: 1 per plan group) and the megafused-vs-v1 group wall
+    # delta — advisory because CPU fake-mesh walls are noisy; the hard
+    # "1 dispatch, byte-identical" invariants live in
+    # tests/test_megafuse.py
+    ("fusion_v2_dispatches", -1),
+    ("group_wall_delta_pct", -1),
 )
 
 DEFAULT_WINDOW = 3
@@ -138,6 +146,14 @@ def record_metrics(rec: dict) -> Optional[dict]:
         d = (pa.get(variant) or {}).get("dispatches")
         if d is not None:
             m[f"dispatches_{variant}"] = d
+    ma = pa.get("mega") or {}
+    if not ma.get("error"):
+        # fusion v2 (plan/fuser megafuse): steady-state per-pipeline
+        # dispatch count on the 8-way fake mesh + group-path wall delta
+        if ma.get("fusion_v2_dispatches") is not None:
+            m["fusion_v2_dispatches"] = ma["fusion_v2_dispatches"]
+        if ma.get("group_wall_delta_pct") is not None:
+            m["group_wall_delta_pct"] = ma["group_wall_delta_pct"]
     sa = det.get("serve_ab") or {}
     if not sa.get("error"):
         for phase in ("cold", "warm"):

@@ -69,6 +69,19 @@ run_lint_full() {
   python scripts/mrlint.py --json mrlint.json --publish
 }
 
+run_megafuse_subset_quick() {
+  echo "== megafuse subset (fast): fused-vs-eager goldens + interpret kernels =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_megafuse.py -q \
+      -k 'golden or kernel' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_megafuse_subset_full() {
+  echo "== megafuse subset (full): dispatch counts, fallbacks, chaos, telemetry =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_megafuse.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_wire_subset_quick() {
   echo "== wire-codec subset (fast): codec round-trip + goldens =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_wire.py -q \
@@ -124,6 +137,7 @@ if [ "${1:-}" = "quick" ]; then
   run_context_subset
   run_elastic_subset_quick
   run_wire_subset_quick
+  run_megafuse_subset_quick
   bench_compare_advisory
   exit 0
 fi
@@ -147,4 +161,5 @@ run_serve_subset_full
 run_context_subset
 run_elastic_subset_full
 run_wire_subset_full
+run_megafuse_subset_full
 bench_compare_advisory

@@ -375,6 +375,65 @@ def main():
               f"pairs/s, wire ratio {ratio:.2f}"
               + (" (1-wide mesh: no exchange)" if width == 1 else ""))
 
+    def do_group_heavy():
+        # fusion-v2 row (plan/fuser + ops/pallas/group): the canonical
+        # group-bound pipeline (moderate key cardinality, every row
+        # lands in a group) run fused on the mesh under
+        # MRTPU_PALLAS_GROUP={0,1} — publishes sustained group-path
+        # throughput for both engines so the kernel-vs-sort delta is
+        # tracked across the soak series, and asserts the two engines'
+        # outputs agree (the byte-identity contract of doc/perf.md)
+        from gpu_mapreduce_tpu.oink.kernels import count as count_k
+        wmesh = mesh if nmesh > 1 else make_mesh(
+            min(8, len(jax.devices())))
+        # capped below the other workloads' scale: on CPU the pallas=1
+        # leg runs the kernels in interpret mode (sequential emulated
+        # scatter — the honest cost of forcing them off-TPU, doc/perf.md)
+        rows = min(max(nedges, 1 << 16), 1 << 18)
+        gkeys = ((np.arange(rows, dtype=np.uint64) * 7919)
+                 % max(rows >> 6, 97)).astype(np.uint64)
+        ones = np.ones(rows, np.int64)
+
+        def run_group():
+            mr = MapReduce(wmesh, fuse=1)
+            mr.map(1, lambda i, kv, p: kv.add_batch(gkeys, ones))
+            t0 = time.perf_counter()
+            mr.aggregate()
+            mr.convert()
+            nu = int(mr.reduce(count_k, batch=True))
+            return nu, time.perf_counter() - t0
+
+        # mrlint: disable=knob-bypass — A/B save/restore must keep the
+        # unset-vs-empty distinction env_str collapses
+        prev = os.environ.get("MRTPU_PALLAS_GROUP")
+        results = {}
+        try:
+            for flag in ("0", "1"):
+                os.environ["MRTPU_PALLAS_GROUP"] = flag
+                run_group()            # compiles + arm megafuse caches
+                run_group()
+                nu, dt = run_group()   # steady state (megafused)
+                results[flag] = nu
+                published[f"group_rows_per_sec_pallas{flag}"] = round(
+                    rows / dt, 1)
+                print(f"group_heavy[pallas={flag}]: {rows} rows, {nu} "
+                      f"groups in {dt:.2f}s -> {rows / dt:,.0f} rows/s")
+        finally:
+            if prev is None:
+                os.environ.pop("MRTPU_PALLAS_GROUP", None)
+            else:
+                os.environ["MRTPU_PALLAS_GROUP"] = prev
+        if results.get("0") != results.get("1"):
+            raise RuntimeError(
+                f"group_heavy engines disagree: {results}")
+        # headline = the SHIPPED default's engine (auto: kernels on
+        # TPU, sort path on CPU where pallas runs in interpret mode)
+        from gpu_mapreduce_tpu.ops.pallas.group import \
+            pallas_group_enabled
+        default_leg = "1" if pallas_group_enabled() else "0"
+        published["group_rows_per_sec"] = \
+            published[f"group_rows_per_sec_pallas{default_leg}"]
+
     def do_pagerank():
         n = 1 << scale
         src = edges[:, 0].astype(np.int32)
@@ -694,6 +753,7 @@ def main():
                  ("external", do_external),
                  ("ingest", do_ingest_overlap),
                  ("shuffle_skew", do_shuffle_skew),
+                 ("group_heavy", do_group_heavy),
                  ("pagerank", do_pagerank),
                  ("pagerank_northstar", do_pagerank_northstar),
                  ("serve", do_serve)]

@@ -89,6 +89,67 @@ def test_purity_clean(tmp_path):
     assert live == []
 
 
+def test_purity_walks_partial_wrapped_pallas_kernel(tmp_path):
+    """The ops/pallas call-site idiom — the kernel body handed to
+    ``pallas_call`` wrapped as ``functools.partial(kernel, static...)``
+    — is seeded as a traced entry: a host effect inside the kernel
+    body must be found (the fixture mirrors ops/pallas/group.py's
+    paged table kernel shape)."""
+    src = """
+        import functools
+        import os
+        from jax.experimental import pallas as pl
+
+        def _table_kernel(T, page, base, k_ref, out_ref):
+            limit = int(os.environ.get("MRTPU_DEBUG_T", T))  # host read
+            out_ref[:] = k_ref[:] + limit
+
+        def run_pages(keys, T, page):
+            return pl.pallas_call(
+                functools.partial(_table_kernel, T, page, 0),
+                out_shape=None,
+            )(keys)
+    """
+    _, live = run_fixture(str(tmp_path), {"mod.py": src},
+                          ["trace-purity"])
+    assert any(f.rule == "purity-host-call"
+               and "_table_kernel" in f.symbol + f.msg
+               for f in live), live
+
+
+def test_knob_registry_sees_fusion_v2_knobs():
+    """The fusion-v2 knobs route through utils/env.py and carry
+    doc/settings.md rows — the pair the knob-registry rule reconciles
+    (any drift re-opens a knob-undocumented/knob-stale finding in the
+    self-check below)."""
+    with open(os.path.join(REPO, "doc", "settings.md")) as f:
+        doc = f.read()
+    assert "MRTPU_MEGAFUSE" in doc and "MRTPU_PALLAS_GROUP" in doc
+    from gpu_mapreduce_tpu.ops.pallas.group import pallas_group_enabled
+    from gpu_mapreduce_tpu.plan.fuser import megafuse_enabled
+    assert isinstance(megafuse_enabled(), bool)
+    assert isinstance(pallas_group_enabled(), bool)
+
+
+def test_purity_clean_partial_pallas_kernel(tmp_path):
+    """The same shape with a pure kernel body stays clean."""
+    src = """
+        import functools
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _table_kernel(T, k_ref, out_ref):
+            out_ref[:] = jnp.cumsum(k_ref[:])[:T]
+
+        def run(keys, T):
+            return pl.pallas_call(functools.partial(_table_kernel, T),
+                                  out_shape=None)(keys)
+    """
+    _, live = run_fixture(str(tmp_path), {"mod.py": src},
+                          ["trace-purity"])
+    assert live == []
+
+
 def test_purity_taint_coercion_and_transitive(tmp_path):
     # float(param) in a helper REACHED from a jit body, param tainted
     # through the call chain; plus a lock acquisition in traced code

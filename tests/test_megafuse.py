@@ -1,0 +1,403 @@
+"""Fusion v2 (megafused single-dispatch plan groups, plan/fuser.py) +
+the Pallas segment-group/segment-reduce table kernels
+(ops/pallas/group.py): interpret-mode kernel goldens, fused-vs-eager
+byte identity (wire on/off, pallas on/off, chaos), the "1 dispatch per
+plan group" steady-state assertion, speculation-miss fallbacks, the
+kernel-launch dispatch accounting, and the fusion telemetry surfaces
+(mr.stats()["plan"]["fusion"], the per-request profile)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.core.runtime import global_counters
+from gpu_mapreduce_tpu.ops.pallas import group as pgroup
+from gpu_mapreduce_tpu.ops.reduces import (count, cull, max_values,
+                                           sum_values)
+from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+
+
+def ndispatch():
+    return global_counters().snapshot()["ndispatch"]
+
+
+def scan_pairs(mr):
+    got = []
+    mr.scan_kv(lambda k, v, p: got.append((k if isinstance(k, bytes)
+                                           else int(k), int(v))))
+    return sorted(got)
+
+
+def run_chain(comm, fuse, kernel, keys, vals):
+    mr = MapReduce(comm, fuse=fuse)
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+    mr.aggregate()
+    mr.convert()
+    n = mr.reduce(kernel, batch=True)
+    return int(n), scan_pairs(mr)
+
+
+def intcount_keys(n=8000, card=97):
+    k = ((np.arange(n, dtype=np.uint64) * 7919) % card).astype(np.uint64)
+    return k, np.arange(n, dtype=np.int64)
+
+
+def warm_pipeline(mr, keys, vals, kernel=count):
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+    mr.aggregate()
+    mr.convert()
+    return int(mr.reduce(kernel, batch=True))
+
+
+# ---------------------------------------------------------------------------
+# interpret-mode kernel unit goldens (CPU)
+# ---------------------------------------------------------------------------
+
+def _table_reference(keys, vals, nvalid):
+    """numpy oracle: per-key count and exact mod-2^64 sum."""
+    cnts, sums = {}, {}
+    for k, v in zip(keys[:nvalid].tolist(), vals[:nvalid].tolist()):
+        cnts[k] = cnts.get(k, 0) + 1
+        sums[k] = (sums.get(k, 0) + int(v)) % (1 << 64)
+    return cnts, sums
+
+
+@pytest.mark.parametrize("reduce_op", ["count", "sum"])
+def test_kernel_table_golden(rng, reduce_op):
+    """The paged table kernel + slot epilogue against a numpy oracle:
+    ascending unique keys, exact counts/sums, zero fill — the layout
+    the sort path emits."""
+    cap, nvalid, gcap = 1024, 900, 256
+    keys = (rng.integers(0, 150, cap).astype(np.uint64)
+            * np.uint64(0x9E3779B97F4A7C15))
+    vals = rng.integers(-(1 << 40), 1 << 40, cap).astype(np.int64)
+    T = pgroup.table_slots(gcap)
+    cfg = ("tbl", T, 256, True)
+    ukey, uval, g, overflow = jax.jit(
+        lambda k, v, n: pgroup.segment_group_reduce(
+            k, v, n, gcap, reduce_op, cfg))(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.int32(nvalid))
+    cnts, sums = _table_reference(keys, vals, nvalid)
+    uk = np.sort(np.asarray(list(cnts), np.uint64))
+    got_k = np.asarray(ukey)
+    got_v = np.asarray(uval)
+    assert int(overflow) == 0
+    assert int(g) == len(uk)
+    assert np.array_equal(got_k[:len(uk)], uk)
+    assert (got_k[len(uk):] == 0).all() and (got_v[len(uk):] == 0).all()
+    for i, k in enumerate(uk.tolist()):
+        if reduce_op == "count":
+            assert int(got_v[i]) == cnts[k]
+        else:
+            assert int(np.uint64(got_v[i].astype(np.uint64))) == sums[k]
+
+
+def test_kernel_paged_matches_single_page(rng):
+    """Page seams are invisible: tiny pages == one page, bit for bit."""
+    cap, gcap = 777, 128
+    keys = rng.integers(0, 60, cap).astype(np.uint64)
+    vals = rng.integers(0, 1 << 30, cap).astype(np.int64)
+    T = pgroup.table_slots(gcap)
+    outs = []
+    for page in (64, 1024):
+        cfg = ("tbl", T, page, True)
+        outs.append(pgroup.segment_group_reduce(
+            jnp.asarray(keys), jnp.asarray(vals), jnp.int32(cap), gcap,
+            "sum", cfg))
+    for a, b in zip(outs[0], outs[1]):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_kernel_overflow_detected(rng):
+    """More distinct keys than table slots: the overflow counter is
+    nonzero (the megafuse validation evidence) — never silent drops."""
+    cap = 512
+    keys = np.arange(cap, dtype=np.uint64) * np.uint64(7919)
+    vals = np.ones(cap, np.int64)
+    cfg = ("tbl", 64, 512, True)   # 64 slots, 512 distinct keys
+    _uk, _uv, _g, overflow = pgroup.segment_group_reduce(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.int32(cap), 64,
+        "count", cfg)
+    assert int(overflow) > 0
+
+
+def test_kernel_signed_and_narrow_dtypes(rng):
+    """int32 keys / int32 values: signed reconstruction is exact and
+    sums wrap mod 2^32 exactly like the eager segment_sum."""
+    cap, gcap = 600, 64
+    keys = rng.integers(-30, 30, cap).astype(np.int32)
+    vals = rng.integers(-(1 << 30), 1 << 30, cap).astype(np.int32)
+    T = pgroup.table_slots(gcap)
+    cfg = ("tbl", T, 1024, True)
+    ukey, uval, g, overflow = pgroup.segment_group_reduce(
+        jnp.asarray(keys), jnp.asarray(vals), jnp.int32(cap), gcap,
+        "sum", cfg)
+    assert int(overflow) == 0
+    uk = np.sort(np.unique(keys))
+    assert np.array_equal(np.asarray(ukey)[:len(uk)], uk)
+    for i, k in enumerate(uk.tolist()):
+        ref = np.int32(vals[keys == k].sum(dtype=np.int32))
+        assert np.asarray(uval)[i] == ref
+    assert int(g) == len(uk)
+
+
+def test_kernel_eager_launch_counts_dispatch(rng):
+    """Satellite: Counters.ndispatch counts pallas_call launches too —
+    one per EAGER page call; launches traced inside a jit ride the
+    enclosing program's count (no double billing), so "1 dispatch per
+    pipeline" cannot be faked by moving work into uncounted kernels."""
+    cap = 512
+    keys = jnp.asarray(rng.integers(0, 40, cap).astype(np.uint64))
+    vals = jnp.asarray(np.ones(cap, np.int64))
+    d0 = ndispatch()
+    pgroup.segment_table(keys, vals, jnp.int32(cap), 128, 256, False,
+                         True)   # 2 pages, eager
+    assert ndispatch() - d0 == 2
+    d0 = ndispatch()
+    jax.jit(lambda k, v: pgroup.segment_table(
+        k, v, jnp.int32(cap), 128, 256, False, True))(keys, vals)
+    assert ndispatch() - d0 == 0   # rides the (uncounted-here) jit
+
+
+def test_kernel_mark_launch_counts_dispatch():
+    """The pre-existing mark kernels report their eager launches too."""
+    from gpu_mapreduce_tpu.ops.pallas.match import mark_words_pallas
+    words = jnp.zeros(1 << 10, jnp.uint32)
+    d0 = ndispatch()
+    mark_words_pallas(words, b'<a href="', interpret=True)
+    assert ndispatch() - d0 == 1
+
+
+# ---------------------------------------------------------------------------
+# golden equivalence: eager == fused cold (v1) == fused warm (megafused)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kernel", [count, sum_values, max_values, cull])
+def test_megafuse_golden_all_kernels(kernel):
+    keys, vals = intcount_keys()
+    eager = run_chain(make_mesh(8), 0, kernel, keys, vals)
+    fused_cold = run_chain(make_mesh(8), 1, kernel, keys, vals)
+    fused_warm = run_chain(make_mesh(8), 1, kernel, keys, vals)
+    assert eager == fused_cold == fused_warm
+
+
+@pytest.mark.parametrize("wire", ["0", "1"])
+def test_megafuse_golden_wire_modes(monkeypatch, wire):
+    monkeypatch.setenv("MRTPU_WIRE", wire)
+    keys, vals = intcount_keys()
+    eager = run_chain(make_mesh(8), 0, count, keys, vals)
+    run_chain(make_mesh(8), 1, count, keys, vals)
+    fused_warm = run_chain(make_mesh(8), 1, count, keys, vals)
+    assert eager == fused_warm
+
+
+def test_megafuse_golden_pallas_forced_matches_sort(monkeypatch):
+    """MRTPU_PALLAS_GROUP=1 (the table kernels, interpret mode on this
+    CPU) produces results identical to the sort path, warm and cold."""
+    keys, vals = intcount_keys()
+    sort_path = run_chain(make_mesh(8), 1, count, keys, vals)
+    monkeypatch.setenv("MRTPU_PALLAS_GROUP", "1")
+    on_cold = run_chain(make_mesh(8), 1, count, keys, vals)
+    on_warm = run_chain(make_mesh(8), 1, count, keys, vals)
+    assert sort_path == on_cold == on_warm
+
+
+def test_megafuse_golden_kmv_chain():
+    """[aggregate, convert] (collate for a host reduce) megafuses on
+    the sort path (KMV is kernel-unsupported) — output identical."""
+    from gpu_mapreduce_tpu.apps.wordfreq import _sum
+    keys, _ = intcount_keys()
+    vals = np.ones(len(keys), np.int64)
+
+    def wf(fuse):
+        mr = MapReduce(make_mesh(8), fuse=fuse)
+        mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+        mr.collate()
+        nu = mr.reduce(_sum)
+        return int(nu), scan_pairs(mr)
+
+    eager = wf(0)
+    assert eager == wf(1) == wf(1)
+
+
+def test_megafuse_golden_under_chaos():
+    """shuffle-site chaos injection on the megafused group: the ft/
+    retry re-runs the whole group and output stays byte-identical
+    (the fault point sits before the single dispatch)."""
+    from gpu_mapreduce_tpu import ft
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals)
+    clean = warm_pipeline(mr, keys, vals), scan_pairs(mr)
+    ft.reset()
+    try:
+        ft.schedule(site="shuffle.exchange", rate=1.0, seed=3,
+                    max_faults=2)
+        ft.set_budget("shuffle.exchange", 4)
+        chaos = warm_pipeline(mr, keys, vals), scan_pairs(mr)
+        assert ft.fault_counts().get("shuffle.exchange", 0) >= 1
+        assert chaos == clean
+    finally:
+        ft.reset()
+
+
+# ---------------------------------------------------------------------------
+# the dispatch-count acceptance: 1 per plan group, steady state
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wire", ["0", "1"])
+def test_single_dispatch_per_pipeline(monkeypatch, wire):
+    """[aggregate, convert, reduce(kernel)] under MRTPU_MEGAFUSE=1 on
+    the 8-device fake mesh: ONE Counters.ndispatch per plan group once
+    warm — with and without the wire codec."""
+    monkeypatch.setenv("MRTPU_WIRE", wire)
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals)
+    n1 = warm_pipeline(mr, keys, vals)
+    d0 = ndispatch()
+    n2 = warm_pipeline(mr, keys, vals)
+    assert ndispatch() - d0 == 1
+    assert n1 == n2
+
+
+def test_single_dispatch_with_pallas_kernels(monkeypatch):
+    """Still exactly 1 dispatch with the table kernels forced on: the
+    paged pallas_calls ride the single megafused jit program (the
+    launch counter's tracer check), never a second host dispatch."""
+    monkeypatch.setenv("MRTPU_PALLAS_GROUP", "1")
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals)
+    warm_pipeline(mr, keys, vals)
+    d0 = ndispatch()
+    warm_pipeline(mr, keys, vals)
+    assert ndispatch() - d0 == 1
+
+
+def test_megafuse_off_takes_v1_dispatches(monkeypatch):
+    monkeypatch.setenv("MRTPU_MEGAFUSE", "0")
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals)
+    warm_pipeline(mr, keys, vals)
+    d0 = ndispatch()
+    warm_pipeline(mr, keys, vals)
+    assert ndispatch() - d0 >= 2
+
+
+def test_local_group_single_dispatch():
+    """[convert, reduce] on an already-sharded KV: warm = 1 dispatch
+    (the compact dispatch folds into the cached-capacity program)."""
+    keys, vals = intcount_keys()
+
+    def cycle(mr):
+        mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+        mr.aggregate()
+        _ = mr.kv            # barrier: aggregate replays eagerly
+        mr.convert()
+        return int(mr.reduce(count, batch=True))
+
+    mr = MapReduce(make_mesh(8), fuse=1)
+    cycle(mr)
+    n1 = cycle(mr)
+    d0 = ndispatch()
+    mr.map(1, lambda i, kv, p: kv.add_batch(keys, vals))
+    mr.aggregate()
+    _ = mr.kv
+    dpre = ndispatch()
+    mr.convert()
+    n2 = int(mr.reduce(count, batch=True))
+    assert ndispatch() - dpre == 1
+    assert n1 == n2
+    assert dpre > d0   # the eager aggregate really dispatched before
+
+
+# ---------------------------------------------------------------------------
+# speculation misses fall back, correctly
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_speculation_miss_pack_overflow_falls_back():
+    """Warm on a narrow key range, then feed a wider one: the cached
+    wire pack can't round-trip it, the megafused result is discarded
+    and the v1 path re-runs — output equals eager."""
+    narrow, vals = intcount_keys(card=97)
+    wide = ((np.arange(8000, dtype=np.uint64) * 0x9E3779B97F4A7C15)
+            % np.uint64(1 << 60)).astype(np.uint64)
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, narrow, vals)
+    warm_pipeline(mr, narrow, vals)          # megafuse armed for narrow
+    got = warm_pipeline(mr, wide, vals), scan_pairs(mr)
+    mre = MapReduce(make_mesh(8), fuse=0)
+    ref = warm_pipeline(mre, wide, vals), scan_pairs(mre)
+    assert got == ref
+
+
+@pytest.mark.slow
+def test_speculation_miss_group_growth_falls_back():
+    """Warm on few distinct keys, then many: the cached group capacity
+    (and kernel table) overflow, detected host-side — the sort-path v1
+    replay keeps the output exact."""
+    few, vals = intcount_keys(card=17)
+    many, _ = intcount_keys(card=3000)
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, few, vals)
+    warm_pipeline(mr, few, vals)
+    got = warm_pipeline(mr, many, vals), scan_pairs(mr)
+    mre = MapReduce(make_mesh(8), fuse=0)
+    ref = warm_pipeline(mre, many, vals), scan_pairs(mre)
+    assert got == ref
+
+
+def test_fallback_warns_once(monkeypatch):
+    """Unsupported chains warn exactly once per reason (then silent)."""
+    monkeypatch.setenv("MRTPU_PALLAS_GROUP", "1")
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals, kernel=max_values)   # arm megafuse
+    pgroup._WARNED.clear()   # AFTER arming: a shared plan-cache entry
+    #                          may have megafused (and warned) already
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        warm_pipeline(mr, keys, vals, kernel=max_values)
+        warm_pipeline(mr, keys, vals, kernel=max_values)
+    ours = [w for w in rec if "MRTPU_PALLAS_GROUP" in str(w.message)]
+    assert len(ours) == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry surfaces
+# ---------------------------------------------------------------------------
+
+def test_fusion_stats_in_mr_stats():
+    from gpu_mapreduce_tpu.plan.cache import reset_fusion_stats
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals)
+    reset_fusion_stats()
+    warm_pipeline(mr, keys, vals)
+    fu = mr.stats()["plan"]["fusion"]
+    assert fu["groups"] >= 1 and fu["fused_groups"] >= 1
+    assert fu["mega_groups"] >= 1
+    assert fu["dispatches_saved"] >= 4       # 5 eager − 1 megafused
+    assert fu["dispatches"] <= fu["eager_dispatch_estimate"]
+
+
+def test_profile_fusion_section():
+    """The per-request profile (what GET /v1/jobs/<id>/profile serves)
+    carries the request's own fusion effectiveness."""
+    from gpu_mapreduce_tpu.obs.context import request_scope
+    keys, vals = intcount_keys()
+    mr = MapReduce(make_mesh(8), fuse=1)
+    warm_pipeline(mr, keys, vals)            # warm outside the scope
+    with request_scope(label="megafuse-test") as acct:
+        warm_pipeline(mr, keys, vals)
+    prof = acct.profile()
+    assert prof["fusion"]["fused_groups"] >= 1
+    assert prof["fusion"]["mega_groups"] >= 1
+    assert prof["fusion"]["dispatches_saved"] >= 4
