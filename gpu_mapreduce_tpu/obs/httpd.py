@@ -11,7 +11,13 @@ Built-in routes:
 * ``/metrics.json`` — the structured registry snapshot;
 * ``/flight`` — the flight recorder's current snapshot (without
   writing an artifact); 404 when the recorder is not armed;
-* ``/healthz`` — liveness ("ok").
+* ``/healthz`` — liveness AND readiness: any response at all means the
+  process is alive; the body is ``{"status": "ok"}`` with HTTP 200 when
+  the process is ready for work, or ``{"status": "draining"}`` (or
+  ``"paused"``/``"fenced"``) with HTTP 503 when it is alive but must
+  not receive new work — a draining serve/ replica stays pingable
+  while external LBs and the fleet router stop sending to it
+  (:func:`set_health`).
 
 Subsystems mount further routes with :func:`register_routes` — the
 serve/ daemon's ``/v1/...`` job API rides the same listener (GET and
@@ -46,6 +52,21 @@ RouteHandler = Callable[[str, str, bytes, dict],
 
 _ROUTES: List[Tuple[str, RouteHandler]] = []
 _ROUTES_LOCK = threading.Lock()
+
+# /healthz readiness provider: () -> status string ("ok" = ready; any
+# other value — "draining", "paused", "fenced" — answers 503 so LBs
+# stop routing while the process stays alive and pingable).  One global
+# provider for the process-default listener; a private MetricsServer
+# can carry its own (the fleet router's listener must not report the
+# co-resident daemon's drain state).
+_HEALTH: Optional[Callable[[], str]] = None
+
+
+def set_health(fn: Optional[Callable[[], str]]) -> None:
+    """Install (or clear, with None) the process-default /healthz
+    readiness provider."""
+    global _HEALTH
+    _HEALTH = fn
 
 
 def register_routes(prefix: str, handler: RouteHandler) -> None:
@@ -94,7 +115,7 @@ class _Handler(BaseHTTPRequestHandler):
             path = self.path.split("?", 1)[0]
             if method == "GET" and self._builtin_get(path):
                 return
-            handler = _find_route(path)
+            handler = srv.find_route(path)
             if handler is None:
                 self._send(404, b"not found\n", "text/plain")
                 return
@@ -186,7 +207,19 @@ class _Handler(BaseHTTPRequestHandler):
                                       default=_jsonable).encode(),
                            "application/json")
         elif path == "/healthz":
-            self._send(200, b"ok\n", "text/plain")
+            # liveness (we answered) + readiness (the code): "ok" →
+            # 200, anything else → 503 {"status": ...} so a draining/
+            # paused/fenced replica is alive but not routable
+            provider = getattr(self.server, "_health", None) or _HEALTH
+            status = "ok"
+            if provider is not None:
+                try:
+                    status = str(provider() or "ok")
+                except Exception:
+                    status = "ok"    # a broken provider must not flap
+            self._send(200 if status == "ok" else 503,
+                       json.dumps({"status": status}).encode() + b"\n",
+                       "application/json")
         else:
             return False
         return True
@@ -207,18 +240,40 @@ class _Handler(BaseHTTPRequestHandler):
 class _Httpd(ThreadingHTTPServer):
     daemon_threads = True
 
-    def __init__(self, *a, **kw):
+    def __init__(self, *a, routes=None, health=None, **kw):
         super().__init__(*a, **kw)
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # server-local routes/health beat the process globals: a fleet
+        # router and an embedded daemon in one process each keep their
+        # own /v1/ (and their own readiness) on their own port
+        self._local_routes: List[Tuple[str, RouteHandler]] = \
+            list(routes or [])
+        self._health = health
+
+    def find_route(self, path: str) -> Optional[RouteHandler]:
+        for prefix, handler in self._local_routes:
+            if path.startswith(prefix):
+                return handler
+        if self._local_routes:
+            return None     # a private listener serves ONLY its routes
+        return _find_route(path)
 
 
 class MetricsServer:
-    """One ThreadingHTTPServer on a daemon thread."""
+    """One ThreadingHTTPServer on a daemon thread.  With ``routes``
+    the listener is PRIVATE: it serves only those prefixes (plus the
+    builtin metrics paths) and ignores the process-global route table —
+    how a fleet of in-process replicas (or the router beside a daemon)
+    each get their own port without clobbering each other's ``/v1/``."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 routes: Optional[List[Tuple[str, RouteHandler]]] = None,
+                 health: Optional[Callable[[], str]] = None):
         self.host = host
         self.port = port
+        self._routes = routes
+        self._health = health
         self._httpd: Optional[_Httpd] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -226,7 +281,8 @@ class MetricsServer:
         """Bind + serve; returns the actual port (resolves port 0)."""
         if self._httpd is not None:
             return self.port
-        self._httpd = _Httpd((self.host, self.port), _Handler)
+        self._httpd = _Httpd((self.host, self.port), _Handler,
+                             routes=self._routes, health=self._health)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,

@@ -14,8 +14,11 @@ from .admission import AdmissionQueue
 from .budget import TenantBudgets
 from .client import ServeClient, ServeError
 from .daemon import Server
+from .fleet import FleetMember, owner_of, ring_route
+from .router import Router
 from .session import Session, normalize_payload, run_session
 
 __all__ = ["AdmissionQueue", "TenantBudgets", "ServeClient",
            "ServeError", "Server", "Session", "normalize_payload",
-           "run_session"]
+           "run_session", "FleetMember", "Router", "owner_of",
+           "ring_route"]

@@ -37,7 +37,44 @@ def main(argv=None) -> int:
     p.add_argument("--paused", action="store_true",
                    help="admit + journal but do not execute "
                         "(maintenance staging)")
+    p.add_argument("--fleet", default=None, metavar="DIR",
+                   help="join the replica fleet rooted at DIR "
+                        "(default MRTPU_FLEET_DIR; doc/serve.md)")
+    p.add_argument("--replica-id", default=None, metavar="RID",
+                   help="stable replica id within the fleet "
+                        "(default MRTPU_FLEET_ID or r<pid>)")
+    p.add_argument("--heartbeat", type=float, default=None,
+                   metavar="SECS", help="fleet lease heartbeat "
+                   "interval (default MRTPU_FLEET_HEARTBEAT)")
+    p.add_argument("--lease", type=float, default=None, metavar="SECS",
+                   help="fleet lease TTL (default MRTPU_FLEET_LEASE)")
+    p.add_argument("--router", action="store_true",
+                   help="run the fleet ROUTER instead of a replica "
+                        "(requires --fleet; serve/router.py)")
     args = p.parse_args(argv)
+
+    if args.router:
+        if not args.fleet:
+            p.error("--router requires --fleet DIR")
+        from .router import Router
+        rt = Router(args.fleet, port=args.port)
+        port = rt.start()
+        print(json.dumps({"serving": port, "router": True,
+                          "fleet": args.fleet}), flush=True)
+        stop = [False]
+
+        def _term_r(signum, frame):
+            stop[0] = True
+
+        signal.signal(signal.SIGTERM, _term_r)
+        try:
+            import time as _time
+            while not stop[0]:
+                _time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        rt.stop()
+        return 0
 
     comm = None
     if args.mesh > 0:
@@ -47,10 +84,13 @@ def main(argv=None) -> int:
     from .daemon import Server
     srv = Server(port=args.port, workers=args.workers,
                  queue_cap=args.queue, state_dir=args.state,
-                 comm=comm, paused=args.paused or None)
+                 comm=comm, paused=args.paused or None,
+                 fleet_dir=args.fleet, replica_id=args.replica_id,
+                 heartbeat_s=args.heartbeat, lease_s=args.lease)
     port = srv.start()
     print(json.dumps({"serving": port, "state": srv.state_dir,
-                      "workers": srv.nworkers, "paused": srv.paused}),
+                      "workers": srv.nworkers, "paused": srv.paused,
+                      "rid": srv.rid, "fleet": srv.fleet_dir}),
           flush=True)
 
     def _term(signum, frame):

@@ -29,9 +29,17 @@ class ServeError(MRError):
 
 
 class ServeClient:
-    def __init__(self, base: str, timeout: float = 30.0):
+    def __init__(self, base: str, timeout: float = 30.0,
+                 retries: int = 0, state_dir: Optional[str] = None):
         self.base = base.rstrip("/")
         self.timeout = timeout
+        # connection-level resilience (fleet clients, mrctl): retry a
+        # refused/reset connection up to ``retries`` times with the ft/
+        # backoff curve, re-discovering the fleet between attempts when
+        # we know the state dir — a client pointed at a dead replica
+        # finds the survivors instead of exiting
+        self.retries = max(0, int(retries))
+        self.state_dir = state_dir
 
     @classmethod
     def local(cls, port: int, **kw) -> "ServeClient":
@@ -40,14 +48,73 @@ class ServeClient:
     @classmethod
     def from_state_dir(cls, state_dir: str, **kw) -> "ServeClient":
         """Discover the daemon's bound port from ``<state>/serve.json``
-        (written atomically at start — ephemeral-port friendly)."""
+        (written atomically at start — ephemeral-port friendly).  A
+        FLEET directory (``<state>/fleet/`` exists) discovers the
+        router (``router.json``) first, then any live ready replica."""
         import os
+        kw.setdefault("state_dir", state_dir)
+        if os.path.isdir(os.path.join(state_dir, "fleet")):
+            from .router import discover
+            found = discover(state_dir)
+            if found is not None:
+                return cls.local(found[1], **kw)
+            raise OSError(f"no live router or replica under "
+                          f"{state_dir!r}")
         with open(os.path.join(state_dir, "serve.json")) as f:
             return cls.local(int(json.load(f)["port"]), **kw)
+
+    def _rediscover(self) -> None:
+        """Between connection retries: re-resolve who is serving (the
+        dead replica's lease lapses; the router or a survivor answers)."""
+        if self.state_dir is None:
+            return
+        try:
+            fresh = ServeClient.from_state_dir(self.state_dir)
+            self.base = fresh.base
+        except (OSError, ValueError):
+            pass              # nothing found YET — retry the old base
+
+    @staticmethod
+    def _refused(e: BaseException) -> bool:
+        """A connection-level failure worth retrying (the ft/retry
+        transient classification, applied to the socket layer)."""
+        from ..ft.retry import classify
+        reason = getattr(e, "reason", e)
+        return classify("serve.connect", reason if isinstance(
+            reason, BaseException) else e) == "transient"
+
+    @staticmethod
+    def _never_sent(e: BaseException) -> bool:
+        """The CONNECT itself was refused: nothing was listening, so
+        the request was never delivered anywhere.  Only this narrow
+        class is safe to retry for a non-idempotent POST — a reset
+        mid-exchange may have been ACCEPTED (journaled, 202 lost on
+        the wire), and resubmitting would mint a second session for
+        the same logical job."""
+        reason = getattr(e, "reason", e)
+        return isinstance(reason, ConnectionRefusedError)
 
     # -- wire --------------------------------------------------------------
     def _req(self, method: str, path: str,
              obj: Optional[dict] = None) -> dict:
+        attempt = 0
+        while True:
+            try:
+                return self._req_once(method, path, obj)
+            except ServeError:
+                raise
+            except urllib.error.URLError as e:
+                retryable = self._never_sent(e) if method == "POST" \
+                    else self._refused(e)
+                if attempt >= self.retries or not retryable:
+                    raise
+                from ..ft.retry import _backoff
+                time.sleep(_backoff(attempt))
+                attempt += 1
+                self._rediscover()
+
+    def _req_once(self, method: str, path: str,
+                  obj: Optional[dict] = None, hops: int = 0) -> dict:
         data = json.dumps(obj).encode() if obj is not None else None
         req = urllib.request.Request(
             self.base + path, data=data, method=method,
@@ -56,6 +123,25 @@ class ServeClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read().decode() or "{}")
         except urllib.error.HTTPError as e:
+            if e.code in (307, 308) and hops < 4:
+                # the fleet router's replica redirect: follow it to the
+                # owning replica (urllib only auto-follows GET 30x; the
+                # explicit hop also covers POST and keeps the count
+                # bounded)
+                loc = e.headers.get("Location")
+                e.read()
+                if loc:
+                    from urllib.parse import urlsplit
+                    u = urlsplit(loc)
+                    base = f"{u.scheme}://{u.netloc}"
+                    saved, self.base = self.base, base
+                    try:
+                        return self._req_once(
+                            method, u.path + (f"?{u.query}" if u.query
+                                              else ""), obj,
+                            hops=hops + 1)
+                    finally:
+                        self.base = saved
             raw = e.read().decode(errors="replace")
             try:
                 body = json.loads(raw)
@@ -70,7 +156,8 @@ class ServeClient:
     def submit(self, script: Optional[str] = None,
                ops: Optional[list] = None,
                tenant: str = "default",
-               priority: Optional[int] = None) -> dict:
+               priority: Optional[int] = None,
+               session: Optional[str] = None) -> dict:
         body: dict = {"tenant": tenant}
         if script is not None:
             body["script"] = script
@@ -78,6 +165,10 @@ class ServeClient:
             body["ops"] = ops
         if priority is not None:
             body["priority"] = int(priority)
+        if session is not None:
+            # fleet-router affinity key: submissions sharing a key land
+            # on the same replica of the healthy ring (serve/router.py)
+            body["session"] = str(session)
         return self._req("POST", "/v1/jobs", body)
 
     def jobs(self) -> list:
@@ -152,6 +243,9 @@ class ServeClient:
         return self._req("POST", "/v1/shutdown")
 
     def healthz(self) -> bool:
+        """READY (200 ``{"status": "ok"}``), not merely alive: a
+        draining/paused/fenced replica answers 503 here and reads
+        False — the router/LB routing predicate."""
         try:
             req = urllib.request.Request(self.base + "/healthz")
             with urllib.request.urlopen(req, timeout=self.timeout) as r:

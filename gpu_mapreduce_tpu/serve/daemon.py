@@ -29,6 +29,18 @@ HTTP API (all JSON; see doc/serve.md):
 * ``GET  /v1/stats``              — queue/sessions/tenants/plan-cache.
 * ``POST /v1/drain``              — stop admitting, keep executing.
 * ``POST /v1/shutdown``           — drain, finish the queue, stop.
+
+Fleet mode (``fleet_dir`` / ``MRTPU_FLEET_DIR`` — doc/serve.md#the-
+serve-fleet): N replicas share one directory tree.  Each replica
+heartbeats a lease (serve/fleet.py), mints globally-unique session ids
+(``<rid>.s<seq>``), writes results into the SHARED ``<fleet>/results/``
+store, and watches its peers: an expired lease triggers a journal
+claim — fenced record into the dead journal, then the dead's
+accepted-but-unfinished sessions replay here (mid-run ones resume from
+their copied auto-checkpoints), flagged ``meta.failed_over``.  Fencing
+discipline: a worker executes a session only while this replica's OWN
+lease is current and unclaimed — a paused-then-revived replica finds
+the claim and drops its stale queue instead of double-executing.
 """
 
 from __future__ import annotations
@@ -78,13 +90,37 @@ class Server:
                  queue_cap: Optional[int] = None,
                  state_dir: Optional[str] = None,
                  comm=None, paused: Optional[bool] = None,
-                 budgets: Optional[TenantBudgets] = None):
+                 budgets: Optional[TenantBudgets] = None,
+                 fleet_dir: Optional[str] = None,
+                 replica_id: Optional[str] = None,
+                 heartbeat_s: Optional[float] = None,
+                 lease_s: Optional[float] = None):
         self.port = port if port is not None \
             else env_knob("MRTPU_SERVE_PORT", int, 0)
         self.nworkers = workers if workers is not None \
             else env_knob("MRTPU_SERVE_WORKERS", int, 2)
         cap = queue_cap if queue_cap is not None \
             else env_knob("MRTPU_SERVE_QUEUE", int, 16)
+        # fleet membership (serve/fleet.py): replicas of one fleet
+        # share a directory; each keeps its own state dir under
+        # <fleet>/replicas/<rid> (unless overridden) and its results in
+        # the SHARED <fleet>/results/ store
+        self.fleet_dir = fleet_dir or env_str("MRTPU_FLEET_DIR", "") \
+            or None
+        self.rid = replica_id or env_str("MRTPU_FLEET_ID", "") \
+            or f"r{os.getpid()}"
+        self._fleet = None
+        if self.fleet_dir is not None:
+            from .fleet import FleetMember
+            self._fleet = FleetMember(self.fleet_dir, self.rid,
+                                      heartbeat_s=heartbeat_s,
+                                      lease_s=lease_s)
+        self._fenced = False
+        self.fenced_drops = 0           # claimed sessions we declined
+        self._fleet_suspended = False   # test hook: a stalled replica
+        if self.fleet_dir is not None and state_dir is None:
+            state_dir = os.path.join(self.fleet_dir, "replicas",
+                                     self.rid)
         self.state_dir = state_dir \
             or env_str("MRTPU_SERVE_STATE", "mrtpu-serve")
         # paused = admit + journal but do not execute (maintenance /
@@ -116,6 +152,7 @@ class Server:
         self._ewma_wall = 1.0              # Retry-After estimator
         self._journal = None
         self._owns_httpd = False
+        self._listener = None              # fleet mode: private httpd
         # request-scoped observability (obs/context.py): trace_id →
         # sid routing for the span feed, and per-session watcher queues
         # behind /v1/jobs/<id>/events
@@ -128,7 +165,22 @@ class Server:
         return os.path.join(self.state_dir, "sessions", sid)
 
     def result_path(self, sid: str) -> str:
+        # fleet mode: ONE shared result store for every replica —
+        # takeover dedupe ("is this session already finished?") and the
+        # router's read fallback both need results findable without the
+        # replica that wrote them (sids are rid-prefixed, no collisions)
+        if self.fleet_dir is not None:
+            return os.path.join(self.fleet_dir, "results", sid + ".json")
         return os.path.join(self.state_dir, "results", sid + ".json")
+
+    def _mint_sid(self) -> str:
+        """Caller holds ``_submit_lock``.  Fleet sids carry the replica
+        id (``<rid>.s<seq>``) so they are fleet-unique AND routable —
+        the router parses the owner straight out of the id."""
+        self._seq += 1
+        base = f"s{self._seq:06d}"
+        return f"{self.rid}.{base}" if self.fleet_dir is not None \
+            else base
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> int:
@@ -150,26 +202,62 @@ class Server:
         from ..obs.tracer import get_tracer
         get_tracer().subscribe_once(self._span_feed)
         _CURRENT = self
-        httpd.register_routes("/v1/", self._handle)
-        prev = httpd.get_server()
-        self._owns_httpd = prev is None or not prev.running
-        self.port = httpd.ensure_server(self.port)
+        if self._fleet is not None:
+            # fleet replicas ALWAYS listen privately: two in-process
+            # replicas (tests, embedded fleets) must not fight over the
+            # process-global /v1/ route table, and each replica's
+            # /healthz must report ITS readiness
+            self._listener = httpd.MetricsServer(
+                port=self.port, routes=[("/v1/", self._handle)],
+                health=self._health_status)
+            self.port = self._listener.start()
+        else:
+            httpd.register_routes("/v1/", self._handle)
+            httpd.set_health(self._health_status)
+            prev = httpd.get_server()
+            self._owns_httpd = prev is None or not prev.running
+            self.port = httpd.ensure_server(self.port)
         atomic_write_json(os.path.join(self.state_dir, "serve.json"),
                           {"port": self.port, "pid": os.getpid(),
-                           "paused": self.paused})
+                           "paused": self.paused, "rid": self.rid})
         self._warm_imports()
+        if self._fleet is not None:
+            from . import fleet as _fleet_mod
+            self._fleet.join(self.port, self.state_dir,
+                             state="draining" if self.paused
+                             else "ready")
+            _fleet_mod.enable_fleet_metrics(self._fleet)
+            t = threading.Thread(target=self._fleet_loop,
+                                 name=f"mrtpu-fleet-{self.rid}",
+                                 daemon=True)
+            t.start()
         if not self.paused:
-            for i in range(max(0, self.nworkers)):
-                t = threading.Thread(target=self._worker_loop,
-                                     name=f"mrtpu-serve-w{i}",
-                                     daemon=True)
-                t.start()
-                self._workers.append(t)
+            self._start_workers()
         if self.ttl_s > 0:
             t = threading.Thread(target=self._gc_loop,
                                  name="mrtpu-serve-gc", daemon=True)
             t.start()
         return self.port
+
+    def _start_workers(self) -> None:
+        for i in range(max(0, self.nworkers)):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"mrtpu-serve-w{i}",
+                                 daemon=True)
+            t.start()
+            self._workers.append(t)
+
+    def _health_status(self) -> str:
+        """/healthz readiness (obs/httpd.set_health): liveness is the
+        response existing at all; the STATUS tells LBs and the fleet
+        router whether to send work here."""
+        if self._fenced:
+            return "fenced"
+        if self._draining or self.paused or self._stopped.is_set():
+            # paused is a maintenance drain too: admitted work queues
+            # but does not execute, so routers/LBs must look elsewhere
+            return "draining"
+        return "ok"
 
     def _warm_imports(self) -> None:
         """Import the session execution stack on the main thread BEFORE
@@ -207,9 +295,10 @@ class Server:
         done: Dict[str, str] = {}
         gcd: set = set()
         submits: List[dict] = []
-        for r in recs:
+        claim_recs: List[tuple] = []    # (idx, fleet_claimed record)
+        for i, r in enumerate(recs):
             if r.get("kind") == "serve_submit":
-                submits.append(r)
+                submits.append({**r, "_idx": i})
                 # mrlint: disable=lock-unguarded-mutation — _recover
                 # runs inside start(), before the worker pool spawns
                 self._seq = max(self._seq, int(r.get("seq", 0)))
@@ -217,6 +306,46 @@ class Server:
                 done[r.get("sid", "")] = r.get("status", DONE)
             elif r.get("kind") == "serve_gc":
                 gcd.add(r.get("sid", ""))
+            elif r.get("kind") == "fleet_claimed":
+                claim_recs.append((i, r))
+        if claim_recs and self._fleet is None:
+            # restarted OUTSIDE fleet mode with a claimed journal: no
+            # lease/claim state to arbitrate with — conservatively
+            # leave everything before the last claim to its claimant
+            submits = [r for r in submits
+                       if r["_idx"] > claim_recs[-1][0]]
+        elif claim_recs:
+            # a peer claimed this journal (we died, it took over).
+            # Every submit before a COMPLETED claim belongs to that
+            # claimant — replaying it here would be the double
+            # execution fencing exists to prevent.  Submits after it
+            # (post-revival work at a newer epoch) replay normally.
+            done_gens = {gen for gen, crec in
+                         self._fleet.claims(self.rid)
+                         if crec.get("done")}
+            boundary = max((i for i, r in claim_recs
+                            if r.get("gen", -1) in done_gens),
+                           default=-1)
+            submits = [r for r in submits if r["_idx"] > boundary]
+            cur = self._fleet.current_claim(self.rid)
+            if cur is not None and not cur[1].get("done"):
+                # an UNFINISHED claim: those sessions are in takeover
+                # limbo — if we simply dropped them and rejoined at a
+                # newer epoch, a claimant that died mid-takeover would
+                # orphan them forever (we look alive, so no peer ever
+                # supersedes).  Re-claim our own journal through the
+                # same O_EXCL arbitration every survivor uses: a LIVE
+                # claimant keeps the claim (it replays, we drop), a
+                # dead one loses the supersede race to us and the
+                # sessions stay ours
+                reclaim = self._fleet.claim(self.rid)
+                if reclaim is None:
+                    last = max(i for i, r in claim_recs)
+                    submits = [r for r in submits if r["_idx"] > last]
+                else:
+                    # ours again — already durably journaled HERE,
+                    # which is exactly what claim_done certifies
+                    self._fleet.claim_done(self.rid, reclaim["gen"])
         for r in submits:
             sid = r["sid"]
             if done.get(sid) == "rejected":
@@ -232,6 +361,7 @@ class Server:
                            fmt=r.get("fmt", "oink"),
                            submitted_utc=r.get("utc", ""),
                            priority=int(r.get("priority", 0)),
+                           failed_over=bool(r.get("fo")),
                            # the replayed session keeps its original
                            # trace_id (pre-trace journals get a fresh
                            # one) so the pre-crash artifacts still link
@@ -252,6 +382,189 @@ class Server:
             with self._watch_lock:
                 self._trace_sids[sess.trace_id] = sid
 
+    # -- fleet: heartbeat, failover, fencing -------------------------------
+    def _fleet_loop(self) -> None:
+        """Heartbeat our lease, notice our own fencing, and claim any
+        peer whose lease expired.  Membership upkeep must never take
+        the daemon down."""
+        fleet = self._fleet
+        while not self._stopped.wait(fleet.heartbeat_s):
+            if self._fleet_suspended:     # test hook: a stalled replica
+                continue
+            try:
+                if not self._fenced and fleet.fenced():
+                    self._fenced = True   # a peer owns our old work now
+                st = self._health_status()
+                fleet.renew(state="ready" if st == "ok" else st)
+                # only a replica that can actually EXECUTE work claims:
+                # paused/draining/fenced replicas would sit on a claim
+                if self._fenced or self.paused or self._draining \
+                        or not self._workers:
+                    continue
+                now = time.time()
+                for rid, lease in fleet.peers().items():
+                    if rid == self.rid:
+                        continue
+                    st = fleet.replica_state(rid, lease, now)
+                    if st == "expired":
+                        self._takeover(rid, lease)
+                    elif st == "fenced" and fleet.expired(lease, now):
+                        # a DEAD peer under an UNFINISHED claim: the
+                        # claimant died mid-takeover (or it is our own
+                        # claim, resuming after a restart) — without
+                        # this branch the supersede path in claim()
+                        # is unreachable and the dead peer's
+                        # un-re-journaled sessions are orphaned.  A
+                        # fenced-but-RENEWING lease (revived zombie)
+                        # fails the expired() check and stays skipped;
+                        # claim() itself arbitrates a live claimant
+                        # (returns None while the takeover is in
+                        # flight)
+                        cur = fleet.current_claim(rid)
+                        if cur is not None and not cur[1].get("done"):
+                            self._takeover(rid, lease)
+            except Exception:
+                pass
+
+    def _fence_ok(self) -> bool:
+        """The lease discipline a worker checks before EVERY session:
+        execute only while our own lease is current (by our own clock —
+        no skew allowance on ourselves) and no peer has claimed our
+        journal.  A paused-then-revived replica fails this check and
+        drops its stale queue instead of double-executing sessions the
+        claimant already owns."""
+        if self._fleet is None:
+            return True
+        if self._fenced or self._fleet.fenced():
+            self._fenced = True
+            return False
+        return not self._fleet.self_expired()
+
+    def _takeover(self, dead_rid: str, lease: dict) -> None:
+        """Claim + replay one dead peer's journal.  The claim file
+        (O_EXCL — serve/fleet.py) settles the survivor race; the
+        ``fleet_claimed`` record lands in the DEAD journal before any
+        replay so a restarted/revived dead replica skips the sessions
+        we now own; each replayed session is re-journaled HERE before
+        it enters the queue, so our own death mid- or post-takeover is
+        covered by the normal recovery path."""
+        import shutil
+        claim = self._fleet.claim(dead_rid)
+        if claim is None:
+            return                        # peer won (or already done)
+        t0 = time.monotonic()
+        from ..ft.journal import Journal, read_journal
+        from ..obs import get_tracer
+        from . import fleet as fleet_mod
+        dead_state = lease.get("state_dir") or os.path.join(
+            self.fleet_dir, "replicas", dead_rid)
+        with get_tracer().span("fleet.failover", cat="fleet",
+                               dead=dead_rid, by=self.rid,
+                               epoch=claim["epoch"]) as sp:
+            try:
+                recs = read_journal(dead_state)
+            except MRError:
+                recs = []                 # died before its first record
+            # sids an EARLIER (superseded) claimant already re-journaled
+            # belong to ITS claim chain — its own failover replays them
+            owned_elsewhere: set = set()
+            done_gens: set = set()
+            for gen, crec in self._fleet.claims(dead_rid):
+                if crec.get("done"):
+                    done_gens.add(gen)
+                prev = crec.get("by")
+                if gen >= claim["gen"] or not prev or prev == self.rid:
+                    continue
+                please = self._fleet.lease(prev) or {}
+                pstate = please.get("state_dir") or os.path.join(
+                    self.fleet_dir, "replicas", prev)
+                try:
+                    owned_elsewhere.update(
+                        pr.get("sid", "") for pr in read_journal(pstate)
+                        if pr.get("kind") == "serve_submit")
+                except MRError:
+                    pass
+            # the fence record, BEFORE any replay
+            fj = Journal(dead_state, script_mode=True)
+            try:
+                fj.append({"kind": "fleet_claimed", "dead": dead_rid,
+                           "by": self.rid, "epoch": claim["epoch"],
+                           "gen": claim["gen"]})
+            finally:
+                fj.close()
+            done: Dict[str, str] = {}
+            gcd: set = set()
+            submits: List[dict] = []
+            boundary = -1
+            for i, r in enumerate(recs):
+                kind = r.get("kind")
+                if kind == "serve_submit":
+                    submits.append({**r, "_idx": i})
+                elif kind == "serve_done":
+                    done[r.get("sid", "")] = r.get("status", DONE)
+                elif kind == "serve_gc":
+                    gcd.add(r.get("sid", ""))
+                elif kind == "fleet_claimed" and \
+                        r.get("by") != self.rid and \
+                        r.get("gen", -1) in done_gens:
+                    # only a COMPLETED prior claim is a hard boundary
+                    # (its submits were fully re-journaled under the
+                    # claimant — the rejoin-then-die case).  An
+                    # UNFINISHED claim we are superseding must NOT
+                    # hide the dead replica's submits: the ones its
+                    # claimant did adopt are excluded per-sid via
+                    # owned_elsewhere, the rest replay here
+                    boundary = i
+            n = 0
+            for r in submits:
+                sid = r.get("sid", "")
+                if not sid or done.get(sid) is not None or sid in gcd \
+                        or sid in owned_elsewhere:
+                    continue
+                if r["_idx"] <= boundary:
+                    continue              # a prior claim chain owns it
+                if os.path.exists(self.result_path(sid)):
+                    continue              # finished; shared store has it
+                with self._lock:
+                    if sid in self.sessions:
+                        continue          # idempotent takeover resume
+                src = os.path.join(dead_state, "sessions", sid)
+                dst = self.session_dir(sid)
+                if os.path.isdir(src) and not os.path.isdir(dst):
+                    # a mid-run session's journal + auto-checkpoints
+                    # ride along; run_session detects them and resumes
+                    shutil.copytree(src, dst)
+                from ..obs.context import new_trace_id
+                sess = Session(
+                    sid=sid, tenant=r.get("tenant", "default"),
+                    payload=r.get("payload", ""),
+                    fmt=r.get("fmt", "oink"),
+                    submitted_utc=r.get("utc", ""),
+                    priority=int(r.get("priority", 0)),
+                    failed_over=True,
+                    trace_id=r.get("trace") or new_trace_id())
+                with self._submit_lock:
+                    if self._journal is None:
+                        return            # shutting down mid-takeover
+                    self._journal.append(
+                        {"kind": "serve_submit", "sid": sid,
+                         "tenant": sess.tenant, "fmt": sess.fmt,
+                         "payload": sess.payload, "seq": 0,
+                         "priority": sess.priority,
+                         "utc": sess.submitted_utc, "fo": dead_rid,
+                         "trace": sess.trace_id})
+                    self.queue.offer(sess, force=True,
+                                     priority=sess.priority)
+                    with self._lock:
+                        self.sessions[sid] = sess
+                        self._order.append(sid)
+                    with self._watch_lock:
+                        self._trace_sids[sess.trace_id] = sid
+                n += 1
+            self._fleet.claim_done(dead_rid, claim["gen"])
+            sp.set(sessions=n)
+        fleet_mod.note_failover(time.monotonic() - t0)
+
     def drain(self) -> None:
         self._draining = True
 
@@ -271,7 +584,16 @@ class Server:
             get_tracer().unsubscribe(self._span_feed)
         except Exception:
             pass
-        httpd.unregister_routes("/v1/")
+        if self._fleet is not None:
+            # graceful exit is not a failure: drop the lease so no
+            # survivor claims a journal whose queue we just drained
+            self._fleet.leave()
+        if self._listener is not None:
+            self._listener.stop()
+            self._listener = None
+        else:
+            httpd.unregister_routes("/v1/")
+            httpd.set_health(None)
         if _CURRENT is self:
             _CURRENT = None
         if self._owns_httpd:
@@ -293,6 +615,13 @@ class Server:
         if self._draining:
             return 503, {"error": "draining: not admitting new work"}, \
                 {"Retry-After": 60}
+        if self._fenced:
+            # a fenced replica's journal belongs to its claimant; new
+            # accepts here could never be claimed coherently — refuse
+            # and let the client's retry find the healthy ring
+            return 503, {"error": f"replica {self.rid!r} is fenced "
+                                  f"(its journal was claimed)"}, \
+                {"Retry-After": 5}
         try:
             payload = normalize_payload(body)
         except MRError as e:
@@ -322,8 +651,7 @@ class Server:
                 self._metric_admission("rejected", tenant)
                 return 429, {"error": "admission queue full"}, \
                     {"Retry-After": self.retry_after()}
-            self._seq += 1
-            sid = f"s{self._seq:06d}"
+            sid = self._mint_sid()
             from ..obs.context import new_trace_id
             sess = Session(
                 sid=sid, tenant=tenant, payload=payload, fmt=fmt,
@@ -362,10 +690,21 @@ class Server:
         return 202, {"id": sid, "state": QUEUED, "tenant": tenant,
                      "trace_id": sess.trace_id}, None
 
+    # Retry-After floor for a replica with NO draining capacity (paused
+    # / 0 workers): depth × wall / workers is 0 × anything or a divide
+    # by zero there — and any finite estimate would be a lie, since the
+    # queue is not draining at all.  A constant says "come back when an
+    # operator has unpaused me".
+    _RETRY_AFTER_IDLE = 30
+
     def retry_after(self) -> int:
         """Honest backpressure: the queue's expected drain time under
-        the rolling mean session wall, not a constant."""
-        per = self._ewma_wall / max(1, len(self._workers) or 1)
+        the rolling mean session wall — clamped to a sane floor, never
+        a division by zero or a 0s "immediately" hint."""
+        workers = len(self._workers)
+        if workers <= 0 or self.paused:
+            return self._RETRY_AFTER_IDLE
+        per = max(0.05, self._ewma_wall) / workers
         return max(1, int(self.queue.depth() * per + 0.5))
 
     def _metric_admission(self, outcome: str, tenant: str = "default"
@@ -452,6 +791,15 @@ class Server:
             if sess is None:
                 if self._stopped.is_set() and self.queue.depth() == 0:
                     return
+                continue
+            if not self._fence_ok():
+                # our lease lapsed or a peer claimed our journal: this
+                # session belongs to the claimant now.  Dropping it is
+                # the fence — executing it would be the double run
+                from . import fleet as fleet_mod
+                with self._lock:
+                    self.fenced_drops += 1
+                fleet_mod.note_fenced_drop(self.rid)
                 continue
             with self._lock:
                 self._active += 1
@@ -685,7 +1033,16 @@ class Server:
             for s in self.sessions.values():
                 states[s.state] = states.get(s.state, 0) + 1
             active = self._active
+        fleet = None
+        if self._fleet is not None:
+            fleet = {"rid": self.rid, "epoch": self._fleet.epoch,
+                     "fenced": self._fenced,
+                     "fenced_drops": self.fenced_drops,
+                     "replicas": {rid: self._fleet.replica_state(rid, l)
+                                  for rid, l in
+                                  self._fleet.peers().items()}}
         return {"queue": self.queue.stats(),
+                "fleet": fleet,
                 "sessions": {"active": active, "by_state": states,
                              "total": len(self._order)},
                 "tenants": self.budgets.snapshot(),

@@ -85,6 +85,8 @@ class Session:
     resumed: bool = False
     priority: int = 0             # admission priority (higher first)
     resharded: bool = False       # resumed onto a different mesh width
+    failed_over: bool = False     # replayed here from a dead replica's
+    #                               claimed journal (serve/fleet.py)
     finished_ts: Optional[float] = None   # TTL GC clock (epoch seconds)
     trace_id: str = ""            # request trace context (obs/context)
     account: Optional[object] = field(default=None, repr=False,
@@ -97,6 +99,7 @@ class Session:
                 "wall_s": self.wall_s, "error": self.error,
                 "resumed": self.resumed, "priority": self.priority,
                 "resharded": self.resharded,
+                "failed_over": self.failed_over,
                 "trace_id": self.trace_id}
 
 
@@ -296,6 +299,7 @@ def run_session(server, sess: Session) -> dict:
             "trace_id": sess.trace_id,
             "resumed": sess.resumed,
             "resharded": sess.resharded,
+            "failed_over": sess.failed_over,
             "dispatches": profile["dispatches"],
             "plan_cache": plan_delta,
             "pages": acct.snapshot(),

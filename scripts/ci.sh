@@ -12,6 +12,8 @@
 #                          # + advisory
 #   scripts/ci.sh quick    # plan/metrics/exec/ft/serve fast subsets (~1 min)
 #   scripts/ci.sh lint     # mrlint only (all 5 rules, whole package)
+#   scripts/ci.sh fleet    # serve-fleet subset only (lease/ring units
+#                          # + kill -9 failover goldens + router)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -114,6 +116,19 @@ run_serve_subset_full() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_fleet_subset_quick() {
+  echo "== fleet subset (fast): lease/claim/ring units + router + satellites =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+      -k 'lease or epoch or claim or ring or owner_of or retry_after or healthz or refused or redirect' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_fleet_subset_full() {
+  echo "== fleet subset (full): kill -9 failover goldens + degraded router =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 bench_compare_advisory() {
   # advisory only: the verdict table lands in the CI log; a regression
   # (or a compare bug) must not fail the build — bench.py --gate is the
@@ -127,6 +142,11 @@ if [ "${1:-}" = "lint" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "fleet" ]; then
+  run_fleet_subset_full
+  exit 0
+fi
+
 if [ "${1:-}" = "quick" ]; then
   run_lint_quick
   run_plan_subset
@@ -134,6 +154,7 @@ if [ "${1:-}" = "quick" ]; then
   run_exec_subset
   run_ft_subset
   run_serve_subset_quick
+  run_fleet_subset_quick
   run_context_subset
   run_elastic_subset_quick
   run_wire_subset_quick
@@ -158,6 +179,7 @@ run_metrics_subset
 run_exec_subset
 run_ft_subset
 run_serve_subset_full
+run_fleet_subset_full
 run_context_subset
 run_elastic_subset_full
 run_wire_subset_full

@@ -14,7 +14,12 @@
 
 Daemon discovery: ``--port`` wins; otherwise ``--state DIR`` (or
 ``MRTPU_SERVE_STATE``) reads the bound port from ``DIR/serve.json`` —
-which is how an ephemeral-port (``--port 0``) daemon is addressed.
+which is how an ephemeral-port (``--port 0``) daemon is addressed.  A
+FLEET directory (``DIR/fleet/`` exists) discovers the router first,
+then any live replica, and a refused connection retries with backoff
+(``--retries``, ft/retry semantics) re-running discovery between
+attempts — a client pointed at a dead replica finds the fleet instead
+of exiting 3.  Router replica redirects (307) are followed.
 Exit codes: 0 ok, 2 usage, 3 daemon unreachable, 4 rejected (429/503 —
 stderr carries Retry-After), 5 session failed, 6 still running at the
 --wait/--timeout deadline (`watch` included: a stream that ends before
@@ -36,14 +41,14 @@ if _REPO not in sys.path:
 def _client(args):
     from gpu_mapreduce_tpu.serve.client import ServeClient
     if args.port is not None:
-        return ServeClient.local(args.port)
+        return ServeClient.local(args.port, retries=args.retries)
     state = args.state or os.environ.get("MRTPU_SERVE_STATE")
     if not state:
         print("need --port or --state (or MRTPU_SERVE_STATE)",
               file=sys.stderr)
         sys.exit(2)
     try:
-        return ServeClient.from_state_dir(state)
+        return ServeClient.from_state_dir(state, retries=args.retries)
     except (OSError, ValueError) as e:
         print(f"cannot discover daemon from {state!r}: {e}",
               file=sys.stderr)
@@ -55,6 +60,9 @@ def main(argv=None) -> int:
         "\n", 1)[0], formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--state", default=None)
+    p.add_argument("--retries", type=int, default=3,
+                   help="connection-refused retries (backoff + fleet "
+                        "re-discovery between attempts; 0 = one shot)")
     sub = p.add_subparsers(dest="cmd", required=True)
     sp = sub.add_parser("submit")
     sp.add_argument("file", help="OINK script path, or - for stdin")

@@ -748,6 +748,124 @@ def main():
             # even when a client thread failed the workload
             obs_slo.reset()
 
+    def do_fleet():
+        # serve-fleet row (serve/fleet.py + serve/router.py): N
+        # subprocess replicas behind the consistent-hash router; one
+        # replica is kill -9'd mid-soak with accepted work on it.  The
+        # fleet must finish EVERY accepted request (fleet_requests_lost
+        # is asserted 0, then published) and the takeover wall lands in
+        # fleet_failover_seconds (doc/serve.md#the-serve-fleet)
+        import signal as _signal
+        import subprocess
+        import tempfile
+
+        from gpu_mapreduce_tpu.serve import (Router, ServeClient,
+                                             ServeError, ring_route)
+        nreplicas = max(2, env_knob("SOAK_FLEET_REPLICAS", int, 3))
+        nreqs = env_knob("SOAK_FLEET_REQS", int, 12)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        with tempfile.TemporaryDirectory() as tmp:
+            corpus = os.path.join(tmp, "corpus.txt")
+            rng5 = np.random.default_rng(31)
+            with open(corpus, "w") as f:
+                for w in rng5.integers(0, 512, 20000):
+                    f.write(f"w{w:03d} ")
+            script = (f"variable files index {corpus}\n"
+                      f"wordfreq 5 -i v_files\n")
+            root = os.path.join(tmp, "fleet")
+            rids = [f"r{i}" for i in range(nreplicas)]
+            env = {**os.environ, "MRTPU_FLEET_SKEW": "0.3"}
+            procs = []
+            for rid in rids:
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "gpu_mapreduce_tpu.serve",
+                     "--port", "0", "--fleet", root,
+                     "--replica-id", rid, "--workers", "2",
+                     "--lease", "1.0", "--heartbeat", "0.25"],
+                    cwd=repo, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL)
+                json.loads(p.stdout.readline())   # wait for "serving"
+                procs.append(p)
+            rt = Router(root)
+            rport = rt.start()
+            try:
+                c = ServeClient.local(rport)
+                # session keys chosen so the victim (r0) definitely
+                # holds accepted work when it dies
+                keys, j = [], 0
+                while len(keys) < nreqs:
+                    target = ring_route(f"k{j}", rids)
+                    if len(keys) < 4 and target != rids[0]:
+                        j += 1
+                        continue
+                    keys.append(f"k{j}")
+                    j += 1
+
+                def submit_one(i):
+                    while True:
+                        try:
+                            return c.submit(script=script,
+                                            tenant=f"t{i % 4}",
+                                            session=keys[i])["id"]
+                        except ServeError as e:
+                            if e.code not in (429, 503):
+                                raise
+                            time.sleep(min(2.0, e.retry_after or 1))
+
+                sids = [submit_one(i) for i in range(nreqs // 2)]
+                t_kill = time.perf_counter()
+                os.kill(procs[0].pid, _signal.SIGKILL)
+                procs[0].wait()
+                sids += [submit_one(i)
+                         for i in range(nreqs // 2, nreqs)]
+
+                def res(sid):
+                    try:
+                        with open(os.path.join(
+                                root, "results", sid + ".json")) as f:
+                            return json.load(f)
+                    except (OSError, ValueError):
+                        return None
+
+                deadline = time.monotonic() + 300
+                remaining = set(sids)
+                failover_done = None
+                while remaining and time.monotonic() < deadline:
+                    for sid in list(remaining):
+                        r = res(sid)
+                        if r is None:
+                            continue
+                        remaining.discard(sid)
+                        if failover_done is None and \
+                                (r.get("meta") or {}).get("failed_over"):
+                            failover_done = time.perf_counter()
+                    time.sleep(0.1)
+                assert not remaining, \
+                    f"fleet lost {len(remaining)} accepted requests: " \
+                    f"{sorted(remaining)}"
+                bad = [s for s in sids if res(s)["status"] != "done"]
+                assert not bad, f"failed sessions: {bad}"
+                nfo = sum(1 for s in sids
+                          if res(s)["meta"].get("failed_over"))
+                failover_s = (failover_done - t_kill) \
+                    if failover_done is not None else 0.0
+                published["fleet_requests_lost"] = 0
+                published["fleet_failover_seconds"] = round(failover_s, 2)
+                published["fleet_replicas"] = nreplicas
+                print(f"fleet: {nreqs} reqs over {nreplicas} replicas, "
+                      f"1 killed mid-soak -> 0 lost, {nfo} failed over, "
+                      f"takeover {failover_s:.2f}s")
+            finally:
+                rt.stop()
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                        try:
+                            p.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            p.wait()
+
     workloads = [("degree", do_degree), ("cc_find", do_cc),
                  ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),
                  ("external", do_external),
@@ -756,13 +874,17 @@ def main():
                  ("group_heavy", do_group_heavy),
                  ("pagerank", do_pagerank),
                  ("pagerank_northstar", do_pagerank_northstar),
-                 ("serve", do_serve)]
+                 ("serve", do_serve), ("fleet", do_fleet)]
     if chaos_seed is not None:
         workloads.append(("chaos", do_chaos))
     serve_only = "serve" in sys.argv[1:]
     if serve_only:
         # `soak.py serve`: hammer ONLY the daemon (doc/serve.md)
         workloads = [("serve", do_serve)]
+    if "fleet" in sys.argv[1:]:
+        # `soak.py fleet`: ONLY the replicated-daemon failover soak
+        workloads = [("fleet", do_fleet)]
+        serve_only = True       # partial publish: merge, don't erase
     for i, (name, fn) in enumerate(workloads, 1):
         guard(name, fn)
         if metrics_every and i % metrics_every == 0:

@@ -1,0 +1,925 @@
+"""Serve fleet tests — lease/epoch/claim units, the consistent-hash
+ring, lease-fenced journal failover (in-process and kill -9 chaos
+golden), the degraded-mode router, and the PR's serve-plane satellites
+(healthz readiness split, Retry-After floor, client connection retry +
+redirect follow) — doc/serve.md#the-serve-fleet."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpu_mapreduce_tpu.core.runtime import MRError
+from gpu_mapreduce_tpu.serve import (FleetMember, Router, ServeClient,
+                                     ServeError, Server, owner_of,
+                                     ring_route)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_corpus(path, words, repeat):
+    path.write_text((" ".join(words) + " ") * repeat)
+    return str(path)
+
+
+def wf_script(corpus, top=3, out=None):
+    lines = [f"variable files index {corpus}",
+             f"wordfreq {top} -i v_files" +
+             (f" -o {out} wf" if out else "")]
+    return "\n".join(lines) + "\n"
+
+
+def wait_until(fn, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def replica(root, rid, *, workers=1, paused=False, lease_s=0.6,
+            heartbeat_s=0.1, **kw):
+    return Server(port=0, workers=workers, queue_cap=8,
+                  fleet_dir=str(root), replica_id=rid, paused=paused,
+                  lease_s=lease_s, heartbeat_s=heartbeat_s, **kw)
+
+
+def store_result(root, sid):
+    """Read a terminal session straight from the fleet's SHARED result
+    store (what takeover dedupe and the router fallback read)."""
+    try:
+        with open(os.path.join(str(root), "results",
+                               sid + ".json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def die(srv):
+    """Simulate a kill -9 for an in-process replica: the lease stays
+    on disk (no graceful leave), the listener stops answering, the
+    heartbeat stalls."""
+    srv._fleet_suspended = True
+    if srv._listener is not None:
+        srv._listener.stop()
+
+
+# ---------------------------------------------------------------------------
+# lease / epoch / claim units
+# ---------------------------------------------------------------------------
+
+def test_lease_roundtrip_expiry_and_clock_skew(tmp_path):
+    m = FleetMember(str(tmp_path), "a", heartbeat_s=0.05, lease_s=0.5,
+                    skew_s=0.3)
+    m.join(1234, str(tmp_path / "sa"))
+    lease = m.lease("a")
+    assert lease["rid"] == "a" and lease["port"] == 1234
+    assert lease["epoch"] == m.epoch >= 1
+    assert not m.expired(lease)
+    # clock-skew tolerance: a lease is dead only past expires + skew,
+    # so two hosts disagreeing by < skew can never fail over a live
+    # replica
+    assert not m.expired(lease, now=lease["expires"] + 0.2)
+    assert m.expired(lease, now=lease["expires"] + 0.31)
+    assert m.replica_state("a") == "ready"
+    m.renew(state="draining")
+    assert m.replica_state("a") == "draining"
+    assert m.healthy() == []
+    m.leave()
+    assert m.lease("a") is None
+    assert m.replica_state("a") == "expired"
+
+
+def test_join_epochs_strictly_increase(tmp_path):
+    a = FleetMember(str(tmp_path), "a")
+    b = FleetMember(str(tmp_path), "b")
+    ea = a.join(1, "sa")
+    eb = b.join(2, "sb")
+    assert eb > ea
+    # a rejoin after being claimed lands ABOVE the claim's epoch
+    claim = b.claim("a")
+    assert claim["epoch"] > eb
+    ea2 = a.join(1, "sa")
+    assert ea2 > claim["epoch"]
+    assert not a.fenced()           # the claim covers only the old epoch
+
+
+def test_bad_replica_ids_rejected(tmp_path):
+    for bad in ("a.b", "a/b", "", "a b"):
+        with pytest.raises(MRError):
+            FleetMember(str(tmp_path), bad)
+
+
+def test_claim_race_exactly_one_winner(tmp_path):
+    dead = FleetMember(str(tmp_path), "dead")
+    dead.join(1, "sd")
+    members = [FleetMember(str(tmp_path), f"s{i}") for i in range(4)]
+    for i, m in enumerate(members):
+        m.join(10 + i, f"s{i}")
+    barrier = threading.Barrier(len(members))
+    wins = [None] * len(members)
+
+    def race(i):
+        barrier.wait()
+        wins[i] = members[i].claim("dead")
+
+    threads = [threading.Thread(target=race, args=(i,))
+               for i in range(len(members))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # O_EXCL arbitration: exactly one winner, every loser sees None
+    # (its replay is a no-op by contract)
+    assert sum(1 for w in wins if w is not None) == 1
+    assert len(dead.claims("dead")) == 1
+    # the dead replica is fenced by the claim
+    assert dead.fenced()
+
+
+def test_claim_supersede_only_past_claimant_death(tmp_path):
+    dead = FleetMember(str(tmp_path), "dead")
+    dead.join(1, "sd")
+    b = FleetMember(str(tmp_path), "b", lease_s=0.2, skew_s=0.05)
+    b.join(2, "sb")
+    c = FleetMember(str(tmp_path), "c", lease_s=0.2, skew_s=0.05)
+    c.join(3, "sc")
+    claim_b = b.claim("dead")
+    assert claim_b is not None and claim_b["gen"] == 0
+    # b is live and mid-takeover: c may NOT supersede
+    assert c.claim("dead") is None
+    # b re-claims its own unfinished takeover idempotently
+    assert b.claim("dead")["gen"] == 0
+    # b dies before claim_done: once ITS lease expires, c supersedes
+    # with the next generation (exclusively)
+    wait_until(lambda: c.expired(c.lease("b") or {}),
+               timeout=2.0, msg="claimant lease expiry")
+    claim_c = c.claim("dead")
+    assert claim_c is not None and claim_c["gen"] == 1
+    c.claim_done("dead", 1)
+    # claim_done RETIRES the dead lease: the membership view drops the
+    # replica, so the daemons' monitors stop seeing an eternally-
+    # expired peer to re-claim (a rejoin-then-die starts a fresh lease
+    # at a newer epoch and the NEXT generation)
+    assert c.lease("dead") is None
+    assert "dead" not in c.peers()
+    cur = c.current_claim("dead")
+    assert cur[1].get("done") is True
+
+
+def test_ring_route_stable_and_minimal_remap():
+    rids = ["r1", "r2", "r3"]
+    keys = [f"k{i}" for i in range(200)]
+    placed = {k: ring_route(k, rids) for k in keys}
+    # deterministic
+    assert placed == {k: ring_route(k, rids) for k in keys}
+    # every replica owns a share (vnodes spread the arcs)
+    assert {placed[k] for k in keys} == set(rids)
+    # consistent: dropping r2 remaps ONLY r2's keys
+    survivors = ["r1", "r3"]
+    for k in keys:
+        if placed[k] != "r2":
+            assert ring_route(k, survivors) == placed[k]
+    assert ring_route("x", []) is None
+
+
+def test_owner_of_sid():
+    assert owner_of("r1.s000001") == "r1"
+    assert owner_of("s000001") is None
+
+
+# ---------------------------------------------------------------------------
+# satellites: Retry-After floor, healthz readiness, client resilience
+# ---------------------------------------------------------------------------
+
+def test_retry_after_floor_with_zero_workers(tmp_path):
+    """A paused (0-worker) replica's queue does not drain: the drain-
+    time estimate degenerates (0s or a division by zero) — the hint
+    must clamp to a sane constant floor instead."""
+    srv = Server(port=0, workers=0, paused=True,
+                 state_dir=str(tmp_path / "state"))
+    srv._ewma_wall = 0.0            # worst case: no wall samples yet
+    assert srv.retry_after() == Server._RETRY_AFTER_IDLE
+    # a live worker pool computes the honest estimate, floored at 1
+    live = Server(port=0, workers=1, state_dir=str(tmp_path / "live"))
+    live.start()
+    try:
+        live._ewma_wall = 0.0
+        assert live.retry_after() >= 1
+    finally:
+        live.shutdown()
+
+
+def test_healthz_splits_liveness_from_readiness(tmp_path):
+    """/healthz answers 200 {"status": "ok"} while ready and 503
+    {"status": "draining"} during /v1/drain and while paused — alive
+    either way (the response exists), non-ready for routers/LBs."""
+    def healthz(port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        assert healthz(srv.port) == (200, {"status": "ok"})
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/drain", method="POST"),
+            timeout=5)
+        assert healthz(srv.port) == (503, {"status": "draining"})
+    finally:
+        srv.shutdown()
+    paused = Server(port=0, workers=0, paused=True,
+                    state_dir=str(tmp_path / "p"))
+    paused.start()
+    try:
+        assert healthz(paused.port) == (503, {"status": "draining"})
+    finally:
+        paused.shutdown()
+
+
+def test_client_retries_connection_refused(monkeypatch):
+    """ServeClient retries refused connections with the ft/ backoff
+    curve (bounded by ``retries``) instead of failing the first touch;
+    past the budget the OSError propagates (mrctl's exit-3 contract)."""
+    from gpu_mapreduce_tpu.ft import retry as ft_retry
+    monkeypatch.setattr(ft_retry, "_backoff", lambda a: 0.0)
+    # a port nothing listens on
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    calls = {"n": 0}
+    c = ServeClient.local(port, retries=2, timeout=2.0)
+    orig = c._req_once
+
+    def counting(method, path, obj=None, hops=0):
+        calls["n"] += 1
+        return orig(method, path, obj, hops)
+
+    c._req_once = counting
+    with pytest.raises(OSError):
+        c.stats()
+    assert calls["n"] == 3          # 1 try + 2 retries
+    # retries=0 keeps the old one-shot behavior
+    c0 = ServeClient.local(port, retries=0, timeout=2.0)
+    with pytest.raises(OSError):
+        c0.stats()
+
+
+def test_client_finds_fleet_after_replica_death(tmp_path, monkeypatch):
+    """The satellite end-to-end: a client pointed (via the fleet state
+    dir) at a dead replica re-discovers and lands on a survivor."""
+    from gpu_mapreduce_tpu.ft import retry as ft_retry
+    monkeypatch.setattr(ft_retry, "_backoff", lambda a: 0.05)
+    root = tmp_path / "fleet"
+    a = replica(root, "a")
+    b = replica(root, "b")
+    a.start()
+    b.start()
+    try:
+        c = ServeClient.from_state_dir(str(root), retries=4)
+        assert c.stats()["fleet"] is not None
+        # kill whichever replica the client discovered; the retry
+        # rediscovers the survivor mid-call
+        victim = a if f":{a.port}" in c.base else b
+        die(victim)
+        wait_until(lambda: len(FleetMember(
+            str(root), "probe").healthy()) == 1, timeout=10,
+            msg="victim lease expiry")
+        assert c.stats()["queue"]["cap"] == 8      # served by survivor
+    finally:
+        for srv in (a, b):
+            srv.shutdown()
+
+
+def test_client_follows_router_redirect(tmp_path):
+    root = tmp_path / "fleet"
+    a = replica(root, "a")
+    a.start()
+    rt = Router(str(root), redirect_reads=True)
+    rport = rt.start()
+    try:
+        c = ServeClient.local(rport)
+        corpus = write_corpus(tmp_path / "w.txt", ["re", "direct"], 20)
+        r = c.submit(script=wf_script(corpus, top=2))
+        assert owner_of(r["id"]) == "a"
+        res = c.wait(r["id"])
+        assert res["status"] == "done"
+        # the read went through a 307 hop to the owning replica
+        st = c.status(r["id"])
+        assert st["state"] == "done"
+    finally:
+        rt.stop()
+        a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet behavior (in-process replicas, private listeners)
+# ---------------------------------------------------------------------------
+
+def test_fleet_submit_read_roundtrip_via_router(tmp_path):
+    root = tmp_path / "fleet"
+    a = replica(root, "a", workers=1)
+    b = replica(root, "b", workers=1)
+    a.start()
+    b.start()
+    rt = Router(str(root))
+    rport = rt.start()
+    try:
+        c = ServeClient.local(rport)
+        corpus = write_corpus(tmp_path / "w.txt", ["to", "be", "or"], 40)
+        subs = [c.submit(script=wf_script(corpus), tenant=f"t{i}",
+                         session=f"k{i}")
+                for i in range(4)]
+        assert all(owner_of(r["id"]) in ("a", "b") for r in subs)
+        for r in subs:
+            res = c.wait(r["id"], timeout=120)
+            assert res["status"] == "done"
+            assert "120 words, 3 unique" in res["output"]
+            assert c.status(r["id"])["state"] == "done"
+            prof = c.profile(r["id"])
+            assert prof["profile"]["dispatches"] >= 0
+        st = c.stats()
+        assert sorted(st["healthy"]) == ["a", "b"]
+        listed = {j["id"] for j in c.jobs()}
+        assert listed >= {r["id"] for r in subs}
+    finally:
+        rt.stop()
+        a.shutdown()
+        b.shutdown()
+
+
+def test_failover_claims_and_replays_dead_replica(tmp_path):
+    """Tentpole: a survivor observes the expired lease, claims the dead
+    journal (fenced record BEFORE any replay), replays the accepted-
+    but-unfinished sessions and flags them ``meta.failed_over``."""
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["p", "q", "p"], 25)
+    script = wf_script(corpus, top=2, out="tmp.wf")
+
+    gold = Server(port=0, workers=1, state_dir=str(tmp_path / "gold"))
+    gold.start()
+    try:
+        gc = ServeClient.local(gold.port)
+        golden = gc.wait(gc.submit(script=script)["id"])
+    finally:
+        gold.shutdown()
+
+    victim = replica(root, "v", workers=0, paused=True)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    sids = [c.submit(script=script)["id"] for _ in range(2)]
+    assert all(s.startswith("v.") for s in sids)
+    die(victim)
+
+    survivor = replica(root, "s", workers=1)
+    survivor.start()
+    try:
+        wait_until(lambda: all(store_result(root, s) for s in sids),
+                   timeout=120, msg="failed-over results")
+        for sid in sids:
+            res = store_result(root, sid)
+            assert res["status"] == "done"
+            assert res["meta"]["failed_over"] is True
+            assert res["output"] == golden["output"]
+            assert {k: v["sha256"] for k, v in res["files"].items()} \
+                == {k: v["sha256"] for k, v in golden["files"].items()}
+        # the fenced claim record landed in the DEAD journal
+        vrecs = read_journal(victim.state_dir)
+        claims = [r for r in vrecs if r.get("kind") == "fleet_claimed"]
+        assert claims and claims[0]["by"] == "s"
+        assert claims[0]["epoch"] > victim._fleet.epoch
+        # the claim is marked done, the failover metric counted
+        gen, crec = survivor._fleet.current_claim("v")
+        assert crec.get("done") is True
+        from gpu_mapreduce_tpu.obs.metrics import get_registry
+        assert get_registry().counter(
+            "mrtpu_fleet_failovers_total", "").value() >= 1
+    finally:
+        survivor.shutdown()
+        victim.shutdown()
+
+
+def test_revived_replica_is_fenced_never_double_executes(tmp_path):
+    """THE fencing assertion: a paused replica whose lease expired and
+    whose journal a survivor claimed comes back to life — its workers
+    must drop the claimed sessions (no-op), not run them a second
+    time."""
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["f", "en", "ce"], 20)
+    victim = replica(root, "v", workers=1, paused=True)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    sid = c.submit(script=wf_script(corpus, top=2))["id"]
+    # the replica stalls (heartbeat suspended) but the process lives on
+    victim._fleet_suspended = True
+
+    survivor = replica(root, "s", workers=1)
+    survivor.start()
+    try:
+        wait_until(lambda: store_result(root, sid) is not None,
+                   timeout=120, msg="failed-over result")
+        res = store_result(root, sid)
+        assert res["status"] == "done"
+        # revival: heartbeats resume, workers start — the fence check
+        # must drop the claimed session instead of executing it
+        victim._fleet_suspended = False
+        victim._start_workers()
+        wait_until(lambda: victim.fenced_drops >= 1, timeout=30,
+                   msg="fenced drop")
+        assert victim._fence_ok() is False
+        wait_until(lambda: victim._fenced, timeout=10,
+                   msg="fence flag via heartbeat")
+        assert victim.stats()["fleet"]["fenced"] is True
+        # a fenced replica refuses new submits (503, honest)
+        with pytest.raises(ServeError) as ei:
+            c.submit(script="mr x\n")
+        assert ei.value.code == 503
+        # exactly one execution: the survivor owns the session; the
+        # victim never wrote a result past the claim (shared store has
+        # exactly the survivor's)
+        assert sid in survivor.sessions
+        assert survivor.sessions[sid].state == "done"
+    finally:
+        survivor.shutdown()
+        victim.shutdown()
+
+
+def test_two_survivors_race_one_claim_one_execution(tmp_path):
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["ra", "ce"], 15)
+    victim = replica(root, "v", workers=0, paused=True)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    sid = c.submit(script=wf_script(corpus, top=2))["id"]
+    die(victim)
+    s1 = replica(root, "s1", workers=1)
+    s2 = replica(root, "s2", workers=1)
+    s1.start()
+    s2.start()
+    try:
+        wait_until(lambda: os.path.exists(
+            os.path.join(str(root), "results", sid + ".json")),
+            timeout=60, msg="failed-over result")
+        # exactly one claim generation exists, and exactly one survivor
+        # adopted the session
+        assert len(s1._fleet.claims("v")) == 1
+        owners = [s for s in (s1, s2) if sid in s.sessions]
+        assert len(owners) == 1
+        res = ServeClient.local(owners[0].port).wait(sid, timeout=60)
+        assert res["status"] == "done"
+        assert res["meta"]["failed_over"] is True
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+        victim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the degraded-mode router
+# ---------------------------------------------------------------------------
+
+def test_router_degraded_honest_503_and_healthy_subset(tmp_path):
+    root = tmp_path / "fleet"
+    os.makedirs(root, exist_ok=True)
+    rt = Router(str(root))
+    rport = rt.start()
+    try:
+        c = ServeClient.local(rport)
+        # zero replicas: 503 + Retry-After, never a hang or a 500
+        with pytest.raises(ServeError) as ei:
+            c.submit(script="mr x\n")
+        assert ei.value.code == 503
+        assert ei.value.retry_after >= 1
+        with pytest.raises(ServeError) as ei:
+            c.status("v.s000001")
+        assert ei.value.code == 503
+        # the router's own healthz says non-ready while unroutable
+        with pytest.raises(urllib.error.HTTPError) as hei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{rport}/healthz", timeout=5)
+        assert hei.value.code == 503
+
+        # one replica up: the healthy subset serves
+        a = replica(root, "a", workers=1)
+        b = replica(root, "b", workers=1)
+        a.start()
+        b.start()
+        try:
+            corpus = write_corpus(tmp_path / "w.txt", ["s", "ub"], 10)
+            r = c.submit(script=wf_script(corpus, top=2))
+            assert c.wait(r["id"], timeout=120)["status"] == "done"
+            # drain b: the ring shrinks to a, submits keep landing
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{b.port}/v1/drain", method="POST"),
+                timeout=5)
+            wait_until(lambda: rt.fleet.healthy() == ["a"], timeout=10,
+                       msg="drained replica leaving the ring")
+            for i in range(3):
+                r2 = c.submit(script=wf_script(corpus, top=2),
+                              session=f"k{i}")
+                assert owner_of(r2["id"]) == "a"
+            # the replicas gauge tells the truth
+            from gpu_mapreduce_tpu.obs.metrics import get_registry
+            snap = get_registry().collect()["mrtpu_fleet_replicas"]
+            by_state = {s["labels"]["state"]: s["value"]
+                        for s in snap["samples"]}
+            assert by_state.get("ready") == 1
+            assert by_state.get("draining") == 1
+        finally:
+            a.shutdown()
+            b.shutdown()
+    finally:
+        rt.stop()
+
+
+def test_router_result_store_fallback_survives_owner_death(tmp_path):
+    root = tmp_path / "fleet"
+    a = replica(root, "a", workers=1)
+    a.start()
+    rt = Router(str(root))
+    rport = rt.start()
+    try:
+        c = ServeClient.local(rport)
+        corpus = write_corpus(tmp_path / "w.txt", ["fa", "ll"], 12)
+        r = c.submit(script=wf_script(corpus, top=2))
+        res = c.wait(r["id"], timeout=120)
+        assert res["status"] == "done"
+        # the owner dies; its lease lapses — reads must keep working
+        # straight from the shared result store
+        die(a)
+        wait_until(lambda: rt.fleet.healthy() == [], timeout=10,
+                   msg="owner lease expiry")
+        res2 = c.result(r["id"])
+        assert res2["status"] == "done"
+        assert res2["output"] == res["output"]
+        st = c.status(r["id"])
+        assert st["state"] == "done"
+        prof = c.profile(r["id"])
+        assert prof["live"] is False and prof["profile"]
+        # an unknown sid with the fleet fully down: 503, not a lie
+        with pytest.raises(ServeError) as ei:
+            c.result("a.s999999")
+        assert ei.value.code == 503
+    finally:
+        rt.stop()
+        a.shutdown()
+
+
+def test_supersede_after_claimant_death_completes_sessions(tmp_path):
+    """A claimant that dies mid-takeover (claim file present, ``done``
+    never written, fence record already in the dead journal) must not
+    orphan the dead replica's sessions: a second survivor's monitor
+    sees the dead peer fenced under an UNFINISHED claim, supersedes
+    with the next generation, and still replays the original submits
+    (only a COMPLETED prior claim is a replay boundary)."""
+    from gpu_mapreduce_tpu.ft.journal import Journal
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["su", "per"], 15)
+    victim = replica(root, "v", workers=0, paused=True)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    sid = c.submit(script=wf_script(corpus, top=2))["id"]
+    die(victim)
+
+    # first claimant: wins the claim, fences the journal, then dies
+    # before re-journaling anything (one lease write, never renewed)
+    s1 = FleetMember(str(root), "s1", lease_s=0.3, skew_s=0.05)
+    s1.join(1, os.path.join(str(root), "replicas", "s1"))
+    claim1 = s1.claim("v")
+    assert claim1 is not None and claim1["gen"] == 0
+    fj = Journal(victim.state_dir, script_mode=True)
+    try:
+        fj.append({"kind": "fleet_claimed", "dead": "v", "by": "s1",
+                   "epoch": claim1["epoch"], "gen": 0})
+    finally:
+        fj.close()
+
+    survivor = replica(root, "s2", workers=1)
+    survivor.start()
+    try:
+        wait_until(lambda: store_result(root, sid) is not None,
+                   timeout=120, msg="superseded-takeover result")
+        res = store_result(root, sid)
+        assert res["status"] == "done"
+        assert res["meta"]["failed_over"] is True
+        gens = dict(survivor._fleet.claims("v"))
+        assert set(gens) == {0, 1}
+        assert not gens[0].get("done")          # s1 never finished
+        assert gens[1]["by"] == "s2" and gens[1]["done"] is True
+    finally:
+        survivor.shutdown()
+        victim.shutdown()
+
+
+def test_restart_under_unfinished_claim_reclaims_own_sessions(tmp_path):
+    """A replica restarting on a journal that carries an UNFINISHED
+    claim whose claimant died mid-takeover must reclaim its own
+    sessions (next generation, same O_EXCL arbitration) instead of
+    dropping them — once rejoined it looks alive, so no peer would
+    ever supersede on its behalf and the sessions would be orphaned."""
+    from gpu_mapreduce_tpu.ft.journal import Journal
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["re", "cl"], 15)
+    victim = replica(root, "v", workers=0, paused=True)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    sid = c.submit(script=wf_script(corpus, top=2))["id"]
+    die(victim)
+    # a claimant fences the journal, then dies before finishing
+    s1 = FleetMember(str(root), "s1", lease_s=0.2, skew_s=0.05)
+    s1.join(1, os.path.join(str(root), "replicas", "s1"))
+    claim1 = s1.claim("v")
+    assert claim1 is not None
+    fj = Journal(victim.state_dir, script_mode=True)
+    try:
+        fj.append({"kind": "fleet_claimed", "dead": "v", "by": "s1",
+                   "epoch": claim1["epoch"], "gen": 0})
+    finally:
+        fj.close()
+    probe = FleetMember(str(root), "probe")   # the restart's skew view
+    wait_until(lambda: probe.expired(probe.lease("s1") or {}),
+               timeout=10, msg="claimant death")
+    # the victim restarts: recovery supersedes the dead claimant
+    v2 = replica(root, "v", workers=1)
+    v2.start()
+    try:
+        wait_until(lambda: store_result(root, sid) is not None,
+                   timeout=120, msg="reclaimed result")
+        assert store_result(root, sid)["status"] == "done"
+        gens = dict(v2._fleet.claims("v"))
+        assert set(gens) == {0, 1}
+        assert gens[1]["by"] == "v" and gens[1]["done"] is True
+        assert not v2._fenced and not v2._fleet.fenced()
+    finally:
+        v2.shutdown()
+        victim.shutdown()
+
+
+def test_router_reads_new_sids_on_rejoined_minter(tmp_path):
+    """A COMPLETED claim must not shadow a rejoined minter: sessions
+    minted after the rejoin live on the minter while its old claimant
+    still owns the adopted ones — the router walks the whole claim
+    chain instead of trusting its end."""
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["ne", "w"], 12)
+    victim = replica(root, "v", workers=0, paused=True)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    old_sid = c.submit(script=wf_script(corpus, top=2))["id"]
+    die(victim)
+    survivor = replica(root, "s", workers=1)
+    survivor.start()
+    rt = Router(str(root))
+    rport = rt.start()
+    v2 = None
+    try:
+        wait_until(lambda: store_result(root, old_sid) is not None,
+                   timeout=120, msg="takeover result")
+        # the minter rejoins at a newer epoch and mints a NEW session
+        v2 = replica(root, "v", workers=1)
+        v2.start()
+        new_sid = ServeClient.local(v2.port).submit(
+            script=wf_script(corpus, top=3))["id"]
+        assert owner_of(new_sid) == "v" and new_sid != old_sid
+        rc = ServeClient.local(rport)
+        # reads through the router find it live on the minter (the
+        # chain end — the old claimant — answers 404 for it)
+        assert rc.status(new_sid)["state"] in ("queued", "running",
+                                               "done")
+        assert rc.wait(new_sid, timeout=120)["status"] == "done"
+        # and the old failed-over sid still reads fine
+        assert rc.result(old_sid)["status"] == "done"
+    finally:
+        rt.stop()
+        if v2 is not None:
+            v2.shutdown()
+        survivor.shutdown()
+        victim.shutdown()
+
+
+def test_discover_skips_stale_router_record(tmp_path):
+    """A kill -9'd router leaves ``router.json`` behind; discovery
+    must probe it and fall through to a live replica's lease instead
+    of handing every retry the same dead port — and a graceful
+    ``Router.stop`` retires its own record."""
+    from gpu_mapreduce_tpu.serve.router import discover
+    from gpu_mapreduce_tpu.serve.session import atomic_write_json
+    root = tmp_path / "fleet"
+    a = replica(root, "a", workers=1)
+    a.start()
+    try:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        atomic_write_json(os.path.join(str(root), "router.json"),
+                          {"port": dead_port, "pid": 2 ** 30})
+        assert discover(str(root)) == ("replica", a.port)
+        cl = ServeClient.from_state_dir(str(root))
+        assert cl.base.endswith(f":{a.port}")
+        # a LIVE router wins again ...
+        rt = Router(str(root))
+        rport = rt.start()
+        assert discover(str(root)) == ("router", rport)
+        # ... and its graceful stop retires the record
+        rt.stop()
+        assert not os.path.exists(
+            os.path.join(str(root), "router.json"))
+        assert discover(str(root)) == ("replica", a.port)
+    finally:
+        a.shutdown()
+
+
+def test_router_fallback_when_claimant_never_adopted_sid(tmp_path):
+    """A session that FINISHED before its replica died is rightly
+    skipped by the takeover (the shared store already has it) — but
+    then the live claimant answers 404 for it.  The router must fall
+    through to the result store instead of passing that 404 on
+    (found driving the real fleet: kill the owner after its sessions
+    completed, read them back through the router)."""
+    root = tmp_path / "fleet"
+    corpus = write_corpus(tmp_path / "w.txt", ["ad", "opt"], 12)
+    victim = replica(root, "v", workers=1)
+    victim.start()
+    c = ServeClient.local(victim.port)
+    sid = c.submit(script=wf_script(corpus, top=2))["id"]
+    want = c.wait(sid, timeout=120)
+    assert want["status"] == "done"
+    die(victim)
+
+    survivor = replica(root, "s", workers=1)
+    survivor.start()
+    rt = Router(str(root))
+    rport = rt.start()
+    try:
+        # the survivor claims v's journal but adopts nothing (the
+        # session is terminal in the shared store)
+        wait_until(lambda: survivor._fleet.current_claim("v") is not None
+                   and survivor._fleet.current_claim("v")[1].get("done"),
+                   timeout=60, msg="claim completion")
+        assert sid not in survivor.sessions
+        # reads through the router resolve the claim chain to the live
+        # survivor, get its 404, and must still serve from the store
+        rc = ServeClient.local(rport)
+        assert rc.result(sid)["output"] == want["output"]
+        assert rc.status(sid)["state"] == "done"
+        # a sid that exists NOWHERE stays an honest 404
+        with pytest.raises(ServeError) as ei:
+            rc.result("v.s999999")
+        assert ei.value.code == 404
+    finally:
+        rt.stop()
+        survivor.shutdown()
+        victim.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos golden: kill -9 a fleet replica with queued + mid-run sessions
+# ---------------------------------------------------------------------------
+
+def _spawn_replica(root, rid, extra, env_extra=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "MRTPU_FLEET_SKEW": "0.3", **(env_extra or {})}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "gpu_mapreduce_tpu.serve",
+         "--port", "0", "--fleet", str(root), "--replica-id", rid,
+         "--lease", "1.0", "--heartbeat", "0.25"] + extra,
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    line = json.loads(p.stdout.readline())
+    return p, int(line["serving"])
+
+
+def test_fleet_kill9_failover_byte_identical(tmp_path):
+    """The acceptance golden: a 3-replica fleet, one replica SIGKILLed
+    holding accepted-but-unfinished AND mid-run sessions.  Survivors
+    claim its journal; every session reaches a terminal state with
+    output files byte-identical to an uninterrupted single daemon, no
+    session executes twice, and the restarted victim is fenced off its
+    pre-claim work."""
+    import io
+
+    from gpu_mapreduce_tpu.ft.journal import Journal, read_journal
+    from gpu_mapreduce_tpu.oink.script import OinkScript
+
+    corpus = write_corpus(tmp_path / "w.txt", ["p", "q", "p", "r"], 25)
+    midrun_script = (f"variable files index {corpus}\n"
+                     f"wordfreq 3 -i v_files -o tmp.wf wf\n"
+                     f"print \"after-ckpt marker\"\n")
+    queued_scripts = [wf_script(corpus, top=k, out=f"tmp.q{k}")
+                      for k in (2, 3)]
+
+    # golden: an uninterrupted single daemon runs all three
+    gold = Server(port=0, workers=1, state_dir=str(tmp_path / "gold"))
+    gold.start()
+    try:
+        gc = ServeClient.local(gold.port)
+        golden = {s: gc.wait(gc.submit(script=s)["id"], timeout=240)
+                  for s in [midrun_script] + queued_scripts}
+    finally:
+        gold.shutdown()
+    assert all(g["status"] == "done" for g in golden.values())
+
+    # manufacture the victim's mid-run session exactly as run_session
+    # would have left it at death: journal + checkpoint after the
+    # wordfreq, no output for the print yet (sid v.s000001 = the
+    # victim's first submit)
+    root = tmp_path / "fleet"
+    vstate = os.path.join(str(root), "replicas", "v")
+    sdir = os.path.join(vstate, "sessions", "v.s000001")
+    outdir = os.path.join(sdir, "out")
+    os.makedirs(outdir, exist_ok=True)
+    crash = OinkScript(screen=io.StringIO())
+    crash._ft_journal = Journal(sdir, script_mode=True, every=1)
+    crash._path_prepend = outdir
+    lines = midrun_script.splitlines()
+    crash._ft_pending_begin = (lines, "<serve>")
+    for ln in lines[:2]:
+        crash.one(ln)
+    crash._ft_journal.close()
+
+    # the victim (paused: sessions journal + queue, never execute)
+    pv, vport = _spawn_replica(root, "v", ["--paused"])
+    try:
+        vc = ServeClient.local(vport)
+        sids = [vc.submit(script=midrun_script)["id"]]
+        sids += [vc.submit(script=s)["id"] for s in queued_scripts]
+        assert sids[0] == "v.s000001"
+    finally:
+        os.kill(pv.pid, signal.SIGKILL)
+        pv.wait()
+
+    # two live survivors take over
+    p1, port1 = _spawn_replica(root, "s1", ["--workers", "2"])
+    p2, port2 = _spawn_replica(root, "s2", ["--workers", "2"])
+    try:
+        def result(sid):
+            try:
+                with open(os.path.join(str(root), "results",
+                                       sid + ".json")) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+
+        wait_until(lambda: all(result(s) is not None for s in sids),
+                   timeout=180, msg="fleet failover results")
+        wanted = {sids[0]: golden[midrun_script],
+                  sids[1]: golden[queued_scripts[0]],
+                  sids[2]: golden[queued_scripts[1]]}
+        for sid, want in wanted.items():
+            got = result(sid)
+            assert got["status"] == "done", got.get("error")
+            assert got["meta"]["failed_over"] is True
+            assert {k: v["sha256"] for k, v in got["files"].items()} \
+                == {k: v["sha256"] for k, v in want["files"].items()}
+        # the mid-run session RESUMED (skip the checkpointed command,
+        # replay only the tail) rather than re-running from scratch
+        mid = result(sids[0])
+        assert mid["meta"]["resumed"] is True
+        assert mid["output"] == "after-ckpt marker \n"
+        # fencing on disk: the dead journal carries the claim record,
+        # exactly one claim generation exists, and each sid was
+        # re-journaled by exactly ONE survivor (no double execution)
+        vrecs = read_journal(vstate)
+        assert any(r.get("kind") == "fleet_claimed" for r in vrecs)
+        probe = FleetMember(str(root), "probe")
+        assert len(probe.claims("v")) == 1
+        adopters = {sid: [] for sid in sids}
+        for rid in ("s1", "s2"):
+            rstate = os.path.join(str(root), "replicas", rid)
+            for r in read_journal(rstate):
+                if r.get("kind") == "serve_submit" and \
+                        r.get("sid") in adopters:
+                    adopters[r["sid"]].append(rid)
+        assert all(len(v) == 1 for v in adopters.values()), adopters
+        # a RESTARTED victim is fenced off its claimed work: it lists
+        # none of the pre-claim sessions and replays nothing
+        pv2, vport2 = _spawn_replica(root, "v", ["--paused"])
+        try:
+            vc2 = ServeClient.local(vport2)
+            assert vc2.stats()["sessions"]["total"] == 0
+            assert vc2.stats()["queue"]["depth"] == 0
+        finally:
+            os.kill(pv2.pid, signal.SIGKILL)
+            pv2.wait()
+    finally:
+        for p in (p1, p2):
+            if p.poll() is None:
+                p.kill()
+                p.wait()

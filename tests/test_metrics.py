@@ -213,8 +213,12 @@ def test_metrics_endpoint_scrape_round_trip(obs_state):
         j = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/metrics.json", timeout=10).read())
         assert j["mrtpu_op_latency_seconds"]["type"] == "histogram"
-        assert urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/healthz", timeout=10).read() == b"ok\n"
+        # liveness/readiness split (serve fleet): no provider armed =
+        # ready, JSON body
+        hz = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert hz.status == 200
+        assert json.loads(hz.read()) == {"status": "ok"}
     finally:
         srv.stop()
 
