@@ -328,9 +328,28 @@ class MapReduce:
         rec = self._plan
         if rec is None:
             return
+        # the plan barrier is a cancellation barrier too: a cancelled
+        # request's pending chain is never dispatched (the request
+        # owner then calls discard_plan so the RELEASE path's dataset
+        # reads — also flush barriers — cannot dispatch it either)
+        from ..obs.context import barrier_check
+        barrier_check()
         if rec.auto:
             self._plan = None
         rec.flush()
+
+    def discard_plan(self) -> None:
+        """Drop any pending recorded stages WITHOUT executing them —
+        the cancellation path (serve/session.py): a cancelled request's
+        deferred chain must not dispatch from the cleanup that releases
+        its frames (``kv``/``kmv`` reads are flush barriers).  The
+        stages' PendingCounts stay unresolved and raise if ever read,
+        like any discarded pending value."""
+        rec = self._plan
+        if rec is None:
+            return
+        self._plan = None
+        rec.stages.clear()
 
     # ------------------------------------------------------------------
     # internal helpers
@@ -423,7 +442,14 @@ class MapReduce:
         same counters independently (Span.__enter__) — kept separate on
         purpose: the print path must work with tracing disabled, and the
         disabled tracer must cost nothing, so neither can own the other's
-        snapshot."""
+        snapshot.
+
+        Also the per-op cancellation barrier: a request cancelled (or
+        past its deadline) stops HERE, before the op does any work —
+        the dataset is whatever the previous op left, consistent and
+        checkpointable (obs/context.barrier_check)."""
+        from ..obs.context import barrier_check
+        barrier_check()
         c = self.counters
         self._op_snap = (c.wsize, c.rsize, c.cssize)
         return Timer()

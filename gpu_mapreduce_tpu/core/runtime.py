@@ -41,6 +41,20 @@ class MRError(RuntimeError):
     src/error.cpp:33-67 — both abort; in-process we raise instead)."""
 
 
+class CancelledError(MRError):
+    """A request was cancelled (client DELETE, deadline, or the stall
+    watchdog) and the cancellation flag tripped at an op barrier
+    (obs/context.barrier_check).  Deliberately an :class:`MRError`
+    subclass: the ft/ retry engine classifies MRError as FATAL, so a
+    cancellation is never retried — it propagates straight up to the
+    request owner (the serve/ worker), which records the ``cancelled``
+    terminal state."""
+
+    def __init__(self, reason: str = "cancelled"):
+        self.reason = reason
+        super().__init__(f"request cancelled ({reason})")
+
+
 class Error:
     def all(self, msg: str):  # collective fatal
         raise MRError(msg)

@@ -92,7 +92,9 @@ class RequestAccount:
                  "exchange_wire_logical",
                  "spill_write", "spill_read",
                  "mem_in_use", "mem_hi_water",
-                 "retries", "plan", "fusion", "stages")
+                 "retries", "plan", "fusion", "stages",
+                 "cancel_reason", "deadline", "last_barrier", "barriers",
+                 "cancel_closed")
 
     def __init__(self, trace_id: Optional[str] = None,
                  tenant: str = "", label: str = ""):
@@ -101,6 +103,16 @@ class RequestAccount:
         self.label = label
         self.t0 = time.perf_counter()
         self._lock = threading.Lock()
+        # cooperative cancellation (doc/serve.md#deadlines-and-cancel):
+        # a reason string arms the flag; barrier_check() trips it at the
+        # next op barrier.  Plain attribute writes — str/float
+        # assignment is atomic under the GIL and the checker tolerates
+        # one-barrier staleness, so no lock is needed on this path.
+        self.cancel_reason: Optional[str] = None
+        self.deadline: Optional[float] = None    # time.monotonic()
+        self.last_barrier = time.monotonic()     # stall-watchdog clock
+        self.barriers = 0                        # barrier-progress count
+        self.cancel_closed = False               # disarm is PERMANENT
         self.dispatches = 0
         self.comm_s = 0.0
         self.exchange_count = 0
@@ -217,6 +229,55 @@ class RequestAccount:
                 v = attrs.get(k)
                 if v:
                     row[k] = row.get(k, 0) + int(v)
+
+    # -- cooperative cancellation ------------------------------------------
+    def cancel(self, reason: str = "client") -> None:
+        """Arm the cancellation flag: the request raises
+        :class:`~...core.runtime.CancelledError` at its next op barrier.
+        Idempotent; the FIRST reason wins (a deadline firing after a
+        client cancel must not rewrite the story).  A no-op once the
+        owner disarmed — the release path must stay uncancellable even
+        against a DELETE racing the request's last lines."""
+        with self._lock:
+            if self.cancel_reason is None and not self.cancel_closed:
+                self.cancel_reason = reason
+
+    def set_deadline(self, seconds_from_now: float) -> None:
+        with self._lock:      # pairs with disarm_cancel's clear
+            self.deadline = time.monotonic() + max(0.0, seconds_from_now)
+
+    def check_cancel(self) -> None:
+        """Raise if cancelled or past deadline (the barrier-site hook —
+        attribute reads only on the un-armed fast path; the deadline
+        trip takes the same lock as cancel/disarm so a concurrent
+        disarm can never be overwritten)."""
+        reason = self.cancel_reason
+        if reason is None:
+            dl = self.deadline
+            if dl is None or time.monotonic() <= dl:
+                return
+            with self._lock:
+                if self.cancel_reason is None and \
+                        not self.cancel_closed:
+                    self.cancel_reason = "deadline"
+                reason = self.cancel_reason
+            if reason is None:
+                return      # disarmed concurrently: nothing to stop
+        from ..core.runtime import CancelledError
+        raise CancelledError(reason)
+
+    def disarm_cancel(self) -> None:
+        """Drop the armed flag + deadline, PERMANENTLY: the owner is
+        past the point of stopping (releasing resources, writing the
+        terminal record) — a cancel arriving after this is the
+        cancel-vs-complete race and loses.  The lock makes close-vs-
+        cancel atomic: without it a cancel() preempted between its
+        check and its store could re-arm the flag AFTER the disarm and
+        cancel the release path anyway (serve/session.py)."""
+        with self._lock:
+            self.cancel_closed = True
+            self.cancel_reason = None
+            self.deadline = None
 
     # -- read-out ----------------------------------------------------------
     def profile(self) -> dict:
@@ -421,6 +482,25 @@ def note_span(name: str, cat: str, dur_s: float, attrs: dict) -> None:
     acct = active_account()
     if acct is not None:
         acct.note_span(name, cat, dur_s, attrs)
+
+
+def barrier_check() -> None:
+    """The op-barrier hook (core/mapreduce op start + plan barrier,
+    parallel/shuffle count sync, oink command/checkpoint round): note
+    barrier progress for the stall watchdog, then raise
+    :class:`~..core.runtime.CancelledError` when the active request was
+    cancelled or ran past its deadline.  Cooperative by design — a
+    running program is never interrupted mid-dispatch; it stops at the
+    next barrier with its datasets in a consistent, resumable state
+    (doc/serve.md#deadlines-and-cancel).  No-op (a ContextVar read)
+    when no request context is active."""
+    acct = _CTXVAR.get()
+    if acct is None:
+        return
+    acct.last_barrier = time.monotonic()
+    acct.barriers += 1
+    if acct.cancel_reason is not None or acct.deadline is not None:
+        acct.check_cancel()
 
 
 def reset() -> None:

@@ -322,6 +322,33 @@ class SLOEngine:
                   f"every window ({per}) — flight recorder armed",
                   file=sys.stderr)
 
+    def burning(self, tenant: str,
+                thresh: Optional[float] = None) -> bool:
+        """The multi-window AND, as a query: is ``tenant`` currently
+        burning past ``thresh`` (default ``MRTPU_SLO_BURN``) in EVERY
+        window of its objective?  Same predicate as the alert edge
+        detector — the serve/ admission shedder keys off it, so a
+        tenant is shed exactly when it would (or did) alert
+        (doc/serve.md#slo-burn-shedding)."""
+        obj = self.objective_for(tenant)
+        if obj is None:
+            return False
+        if thresh is None:
+            from ..utils.env import env_knob
+            thresh = env_knob("MRTPU_SLO_BURN", float, 1.0)
+        with self._lock:
+            per = dict(self._burn.get(tenant) or {})
+        if not per:
+            return False
+        return all(per.get(f"{int(w)}s", 0.0) > thresh
+                   for w in obj.windows)
+
+    def min_window(self) -> float:
+        """Shortest declared window — the honest Retry-After scale for
+        burn-driven shedding (the burn decays over this window)."""
+        return min((w for o in self.objectives for w in o.windows),
+                   default=DEFAULT_WINDOWS[0])
+
     # -- read-out ----------------------------------------------------------
     def snapshot(self) -> dict:
         with self._lock:

@@ -85,6 +85,11 @@ class OinkScript:
         self._ft_resharded = False
         self._ft_depth = 0
         self._ft_pending_begin: Optional[tuple] = None
+        # post-command hooks: callables invoked with the script after
+        # EVERY completed non-builtin command (after its journal record
+        # + auto-checkpoint).  The serve/ mesh autoscaler's live
+        # promotion rides here; a raising hook is dropped, never fatal.
+        self.post_cmd: List = []
 
     def _nprocs(self) -> int:
         # query the backend directly — creating (and leaking until the
@@ -292,12 +297,31 @@ class OinkScript:
 
     def _ft_cmd_done(self, command: str):
         """Journal one COMPLETED command (record follows the fact) and
-        auto-checkpoint every MRTPU_CKPT_EVERY commands."""
+        auto-checkpoint every MRTPU_CKPT_EVERY commands.
+
+        Also the command-round cancellation barrier and the generic
+        post-command hook point: hooks run AFTER the journal/checkpoint
+        landed (the serve/ mesh autoscaler promotes here — a clean
+        host-side point between commands), then a cancelled request
+        stops — with the checkpoint already durable, which is what
+        leaves the session directory resumable at this exact boundary
+        (doc/serve.md#deadlines-and-cancel)."""
         j = self._ft_journal
         if j is not None:
             self._ft_flush_begin()
             j.cmd_done(command)
             j.maybe_checkpoint(self.obj)
+        for hook in list(self.post_cmd):
+            try:
+                hook(self)
+            except Exception:
+                # an observer hook must never kill the script it rides
+                # (guarded remove: the hook may have removed itself
+                # before raising)
+                if hook in self.post_cmd:
+                    self.post_cmd.remove(hook)
+        from ..obs.context import barrier_check
+        barrier_check()
 
     def _ft_apply_restore(self):
         rec, self._ft_restore = self._ft_restore, None

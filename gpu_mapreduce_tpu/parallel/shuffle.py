@@ -587,6 +587,13 @@ def exchange(skv: ShardedKV, dest, transport: int = 1,
     from ..ft.inject import fault_point
     from ..ft.retry import retry_call
     from ..obs import NULL_SPAN, get_tracer
+    # the shuffle sync is a cancellation barrier (obs/context): a
+    # cancelled request stops BEFORE the exchange dispatches — the
+    # input frames are untouched, same recovery contract as a fault
+    # injected here.  Outside _once so a cancel never burns the ft/
+    # retry budget (CancelledError is MRError = fatal anyway).
+    from ..obs.context import barrier_check
+    barrier_check()
 
     def _once():
         fault_point("shuffle.exchange")

@@ -11,14 +11,17 @@ is the contract.
 """
 
 from .admission import AdmissionQueue
+from .auth import TokenAuth
 from .budget import TenantBudgets
 from .client import ServeClient, ServeError
 from .daemon import Server
 from .fleet import FleetMember, owner_of, ring_route
+from .overload import BurnShedder, CostProfiles, DiskMonitor
 from .router import Router
 from .session import Session, normalize_payload, run_session
 
 __all__ = ["AdmissionQueue", "TenantBudgets", "ServeClient",
            "ServeError", "Server", "Session", "normalize_payload",
            "run_session", "FleetMember", "Router", "owner_of",
-           "ring_route"]
+           "ring_route", "TokenAuth", "BurnShedder", "CostProfiles",
+           "DiskMonitor"]

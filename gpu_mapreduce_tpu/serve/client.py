@@ -30,7 +30,8 @@ class ServeError(MRError):
 
 class ServeClient:
     def __init__(self, base: str, timeout: float = 30.0,
-                 retries: int = 0, state_dir: Optional[str] = None):
+                 retries: int = 0, state_dir: Optional[str] = None,
+                 token: Optional[str] = None):
         self.base = base.rstrip("/")
         self.timeout = timeout
         # connection-level resilience (fleet clients, mrctl): retry a
@@ -40,6 +41,14 @@ class ServeClient:
         # finds the survivors instead of exiting
         self.retries = max(0, int(retries))
         self.state_dir = state_dir
+        # tenant bearer token (MRTPU_SERVE_TOKENS on the daemon side):
+        # rides every request, including the /events stream and the
+        # healthz probe; defaults from MRTPU_SERVE_TOKEN so mrctl and
+        # the soak/bench harnesses inherit it — doc/serve.md#tenant-auth
+        if token is None:
+            from ..utils.env import env_str
+            token = env_str("MRTPU_SERVE_TOKEN", "") or None
+        self.token = token
 
     @classmethod
     def local(cls, port: int, **kw) -> "ServeClient":
@@ -113,12 +122,18 @@ class ServeClient:
                 attempt += 1
                 self._rediscover()
 
+    def _headers(self, data: bool = False) -> dict:
+        h = {"Content-Type": "application/json"} if data else {}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
     def _req_once(self, method: str, path: str,
                   obj: Optional[dict] = None, hops: int = 0) -> dict:
         data = json.dumps(obj).encode() if obj is not None else None
         req = urllib.request.Request(
             self.base + path, data=data, method=method,
-            headers={"Content-Type": "application/json"} if data else {})
+            headers=self._headers(data is not None))
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return json.loads(r.read().decode() or "{}")
@@ -155,21 +170,55 @@ class ServeClient:
     # -- API ---------------------------------------------------------------
     def submit(self, script: Optional[str] = None,
                ops: Optional[list] = None,
-               tenant: str = "default",
+               tenant: Optional[str] = None,
                priority: Optional[int] = None,
-               session: Optional[str] = None) -> dict:
-        body: dict = {"tenant": tenant}
+               session: Optional[str] = None,
+               deadline_ms: Optional[int] = None,
+               retry_after_wait: float = 0.0) -> dict:
+        """Submit one job.  ``tenant`` omitted means "whatever my
+        bearer token names" on an auth-armed daemon (else "default").
+        ``deadline_ms`` bounds the session's EXECUTION time (cancelled
+        at the next op barrier past it).
+
+        ``retry_after_wait`` (seconds, opt-in): when the daemon answers
+        429 **with a Retry-After** (rate limit, queue backpressure, SLO
+        shed), sleep that hint and resubmit — but only while the TOTAL
+        slept stays within the budget, so a shed client waits honestly
+        instead of hot-looping, yet can never hang past its own bound.
+        0 (default) = raise immediately, the pre-PR-14 behavior."""
+        body: dict = {} if tenant is None else {"tenant": tenant}
         if script is not None:
             body["script"] = script
         if ops is not None:
             body["ops"] = ops
         if priority is not None:
             body["priority"] = int(priority)
+        if deadline_ms is not None:
+            body["deadline_ms"] = int(deadline_ms)
         if session is not None:
             # fleet-router affinity key: submissions sharing a key land
             # on the same replica of the healthy ring (serve/router.py)
             body["session"] = str(session)
-        return self._req("POST", "/v1/jobs", body)
+        budget = max(0.0, float(retry_after_wait))
+        slept = 0.0
+        while True:
+            try:
+                return self._req("POST", "/v1/jobs", body)
+            except ServeError as e:
+                ra = e.retry_after
+                if e.code != 429 or ra is None or ra <= 0 \
+                        or slept + ra > budget:
+                    raise
+                time.sleep(ra)
+                slept += ra
+
+    def cancel(self, sid: str) -> dict:
+        """``DELETE /v1/jobs/<sid>`` — cooperative cancel: queued
+        sessions finalize ``cancelled`` immediately, running ones stop
+        at their next op barrier.  Raises ServeError(409) once the
+        session is terminal (the no-op contract — the result is never
+        touched)."""
+        return self._req("DELETE", f"/v1/jobs/{sid}")
 
     def jobs(self) -> list:
         return self._req("GET", "/v1/jobs")["jobs"]
@@ -186,10 +235,11 @@ class ServeClient:
              poll_s: float = 0.05) -> dict:
         """Poll until the session finishes; returns the result record."""
         deadline = time.monotonic() + timeout
+        from .session import TERMINAL as terminal   # ONE definition
         while True:
             out = self._req("GET", f"/v1/jobs/{sid}/result")
-            if out.get("status") in ("done", "failed") or \
-                    out.get("state") in ("done", "failed"):
+            if out.get("status") in terminal or \
+                    out.get("state") in terminal:
                 return out
             if time.monotonic() > deadline:
                 raise ServeError(408, {"error": f"session {sid} still "
@@ -209,7 +259,8 @@ class ServeClient:
         polling.  ``timeout`` is the per-read socket timeout (the
         server heartbeats every ~15 s, so a dead daemon surfaces as an
         OSError rather than a hang)."""
-        req = urllib.request.Request(self.base + f"/v1/jobs/{sid}/events")
+        req = urllib.request.Request(self.base + f"/v1/jobs/{sid}/events",
+                                     headers=self._headers())
         try:
             r = urllib.request.urlopen(
                 req, timeout=timeout if timeout is not None else 60.0)
@@ -247,7 +298,8 @@ class ServeClient:
         draining/paused/fenced replica answers 503 here and reads
         False — the router/LB routing predicate."""
         try:
-            req = urllib.request.Request(self.base + "/healthz")
+            req = urllib.request.Request(self.base + "/healthz",
+                                         headers=self._headers())
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
                 return r.status == 200
         except (urllib.error.URLError, OSError):

@@ -19,16 +19,24 @@ auto-checkpoint (doc/serve.md#recovery).
 
 HTTP API (all JSON; see doc/serve.md):
 
-* ``POST /v1/jobs``               — submit ``{"script"| "ops", "tenant"}``
-  → 202 ``{"id", "state"}``; 429 + ``Retry-After`` when the queue is
-  full; 503 when draining.
+* ``POST /v1/jobs``               — submit ``{"script"| "ops", "tenant"
+  [, "priority", "deadline_ms"]}`` → 202 ``{"id", "state"}``; 429 +
+  ``Retry-After`` when the queue is full, the tenant is rate-limited,
+  or the tenant is being SLO-burn shed; 503 when draining or degraded.
 * ``GET  /v1/jobs``               — session summaries.
 * ``GET  /v1/jobs/<id>``          — one session's status.
 * ``GET  /v1/jobs/<id>/result``   — the result record (202 while
   pending/running).
+* ``DELETE /v1/jobs/<id>``        — cancel: queued sessions finalize
+  ``cancelled`` immediately, running ones stop at their next op
+  barrier; 409 once terminal.
 * ``GET  /v1/stats``              — queue/sessions/tenants/plan-cache.
 * ``POST /v1/drain``              — stop admitting, keep executing.
 * ``POST /v1/shutdown``           — drain, finish the queue, stop.
+
+With ``MRTPU_SERVE_TOKENS`` armed every route needs ``Authorization:
+Bearer <token>`` — 401/403 are decided BEFORE any journal write;
+drain/shutdown need the admin (``*``) token (serve/auth.py).
 
 Fleet mode (``fleet_dir`` / ``MRTPU_FLEET_DIR`` — doc/serve.md#the-
 serve-fleet): N replicas share one directory tree.  Each replica
@@ -54,9 +62,12 @@ from typing import Dict, List, Optional
 from ..core.runtime import MRError
 from ..utils.env import env_flag, env_knob, env_str
 from .admission import AdmissionQueue
+from .auth import TokenAuth
 from .budget import TenantBudgets
-from .session import (DONE, FAILED, QUEUED, RUNNING, Session,
-                      atomic_write_json, normalize_payload, run_session)
+from .overload import BurnShedder, CostProfiles, DiskMonitor
+from .session import (CANCELLED, DONE, FAILED, QUEUED, RUNNING, TERMINAL,
+                      Session, atomic_write_json, cancelled_record,
+                      normalize_payload, run_session)
 
 _CURRENT: Optional["Server"] = None     # the metrics collector's target
 
@@ -77,6 +88,10 @@ def _collect_serve(reg) -> None:
                   "(bytes_in_use / memsize)", ("tenant",))
     for tenant, snap in srv.budgets.snapshot().items():
         g.set(snap["pages_in_use"], tenant=tenant)
+    reg.gauge("mrtpu_serve_degraded",
+              "1 while the daemon sheds admissions under resource "
+              "pressure (low disk / ENOSPC), else 0"
+              ).set(1 if srv.disk.check() else 0)
 
 
 class Server:
@@ -135,11 +150,42 @@ class Server:
         self.ratelimit = TenantRateLimiter(
             env_knob("MRTPU_SERVE_RATE", float, 0.0),
             env_knob("MRTPU_SERVE_BURST", float, None))
-        # session TTL/GC: done/failed session state past this age is
+        # session TTL/GC: terminal session state past this age is
         # swept by a background thread (0 = keep forever)
         self.ttl_s = max(0.0, env_knob("MRTPU_SERVE_TTL", float, 0.0))
         self.gc_count = 0
         self.budgets = budgets or TenantBudgets()
+        # -- PR 14: the self-protection plane ------------------------------
+        # tenant bearer tokens on /v1/ (serve/auth.py; disarmed when
+        # MRTPU_SERVE_TOKENS is unset)
+        self.auth = TokenAuth()
+        # per-tenant session-cost evidence + the SLO-burn admission
+        # shedder it feeds (serve/overload.py)
+        self.profiles = CostProfiles()
+        self.shedder = BurnShedder(self.profiles)
+        # "tenant|reason" → monotonic ts of the latest shed: the
+        # rising-edge / episode tracker behind _note_shed's journaling
+        # (own lock: mutated by concurrent HTTP handler threads)
+        self._shed_edges: Dict[str, float] = {}
+        self._shed_lock = threading.Lock()
+        # resource-pressure degradation: state dir + shared result
+        # store are the paths whose filesystems must keep room
+        self.disk = DiskMonitor([self.state_dir,
+                                 os.path.dirname(self.result_path("x"))])
+        # hung-session watchdog: no barrier progress for MRTPU_SERVE_
+        # STALL seconds flags the session (and cancels it under
+        # MRTPU_SERVE_STALL_CANCEL=1), arming the flight recorder
+        self.stall_s = max(0.0, env_knob("MRTPU_SERVE_STALL", float, 0.0))
+        self.stall_cancel = env_flag("MRTPU_SERVE_STALL_CANCEL", False)
+        self.stall_count = 0
+        # server-side default execution deadline (ms) for submits that
+        # carry none (0 = unlimited)
+        self.default_deadline_ms = max(
+            0, env_knob("MRTPU_SERVE_DEADLINE", int, 0))
+        # mesh autoscaler (serve/autoscale.py): session width from the
+        # tenant's profiled exchange volume, MRTPU_SERVE_MESH_AUTO=1
+        from .autoscale import MeshAutoscaler
+        self.autoscaler = MeshAutoscaler(comm, self.profiles)
         self.sessions: Dict[str, Session] = {}
         self._order: List[str] = []        # admission order, for /v1/jobs
         self._lock = threading.Lock()
@@ -237,6 +283,11 @@ class Server:
             t = threading.Thread(target=self._gc_loop,
                                  name="mrtpu-serve-gc", daemon=True)
             t.start()
+        if self.stall_s > 0:
+            t = threading.Thread(target=self._stall_loop,
+                                 name="mrtpu-serve-watchdog",
+                                 daemon=True)
+            t.start()
         return self.port
 
     def _start_workers(self) -> None:
@@ -257,7 +308,20 @@ class Server:
             # paused is a maintenance drain too: admitted work queues
             # but does not execute, so routers/LBs must look elsewhere
             return "draining"
+        if self.disk.check():
+            # resource pressure: alive, running sessions finish, but
+            # new work must go elsewhere (doc/reliability.md#daemon-
+            # under-overload) — fleet replicas publish this state on
+            # their lease, so the router drops them from the ring
+            return "degraded"
         return "ok"
+
+    def session_comm(self, sess: Session) -> tuple:
+        """(comm, width) for one session — the mesh autoscaler's pick
+        (full mesh when disarmed; serve/autoscale.py)."""
+        if not self.autoscaler.enabled:
+            return self.comm, None
+        return self.autoscaler.comm_for(sess.tenant)
 
     def _warm_imports(self) -> None:
         """Import the session execution stack on the main thread BEFORE
@@ -294,6 +358,7 @@ class Server:
             return
         done: Dict[str, str] = {}
         gcd: set = set()
+        cancels: Dict[str, str] = {}    # acknowledged mid-run cancels
         submits: List[dict] = []
         claim_recs: List[tuple] = []    # (idx, fleet_claimed record)
         for i, r in enumerate(recs):
@@ -304,6 +369,8 @@ class Server:
                 self._seq = max(self._seq, int(r.get("seq", 0)))
             elif r.get("kind") == "serve_done":
                 done[r.get("sid", "")] = r.get("status", DONE)
+            elif r.get("kind") == "serve_cancel":
+                cancels[r.get("sid", "")] = r.get("reason", "client")
             elif r.get("kind") == "serve_gc":
                 gcd.add(r.get("sid", ""))
             elif r.get("kind") == "fleet_claimed":
@@ -362,6 +429,7 @@ class Server:
                            submitted_utc=r.get("utc", ""),
                            priority=int(r.get("priority", 0)),
                            failed_over=bool(r.get("fo")),
+                           deadline_ms=r.get("dl") or None,
                            # the replayed session keeps its original
                            # trace_id (pre-trace journals get a fresh
                            # one) so the pre-crash artifacts still link
@@ -373,6 +441,35 @@ class Server:
                         self.result_path(sid))
                 except OSError:
                     sess.finished_ts = time.time()
+            elif sid in cancels and \
+                    os.path.exists(self.result_path(sid)):
+                # crash between the result write and its serve_done
+                # record, with an acknowledged cancel in flight: the
+                # durable result wins (never overwrite completed work
+                # with an empty cancelled record) — reload it as a
+                # terminal stub
+                try:
+                    import json as _json
+                    with open(self.result_path(sid)) as f:
+                        sess.state = _json.load(f).get("status", DONE)
+                    sess.finished_ts = os.path.getmtime(
+                        self.result_path(sid))
+                except (OSError, ValueError):
+                    sess.state = CANCELLED
+                    sess.finished_ts = time.time()
+            elif sid in cancels:
+                # the client was told "cancelling" before the crash:
+                # the replay must honor that, not resurrect and run
+                # the session to completion.  Register first (the
+                # finalize pushes events/metrics), then finalize —
+                # result + serve_done + CANCELLED state
+                with self._lock:
+                    self.sessions[sid] = sess
+                    self._order.append(sid)
+                with self._watch_lock:
+                    self._trace_sids[sess.trace_id] = sid
+                self._finalize_cancelled(sess, cancels[sid])
+                continue
             else:
                 self.queue.offer(sess, force=True,
                                  priority=sess.priority)
@@ -397,9 +494,12 @@ class Server:
                 st = self._health_status()
                 fleet.renew(state="ready" if st == "ok" else st)
                 # only a replica that can actually EXECUTE work claims:
-                # paused/draining/fenced replicas would sit on a claim
+                # paused/draining/fenced replicas would sit on a claim,
+                # and a disk-degraded one would adopt sessions straight
+                # into the ENOSPC failures its own submit path sheds —
+                # leave the dead peer to a healthy survivor
                 if self._fenced or self.paused or self._draining \
-                        or not self._workers:
+                        or not self._workers or self.disk.check():
                     continue
                 now = time.time()
                 for rid, lease in fleet.peers().items():
@@ -494,6 +594,7 @@ class Server:
                 fj.close()
             done: Dict[str, str] = {}
             gcd: set = set()
+            cancels: Dict[str, str] = {}
             submits: List[dict] = []
             boundary = -1
             for i, r in enumerate(recs):
@@ -502,6 +603,9 @@ class Server:
                     submits.append({**r, "_idx": i})
                 elif kind == "serve_done":
                     done[r.get("sid", "")] = r.get("status", DONE)
+                elif kind == "serve_cancel":
+                    cancels[r.get("sid", "")] = r.get("reason",
+                                                      "client")
                 elif kind == "serve_gc":
                     gcd.add(r.get("sid", ""))
                 elif kind == "fleet_claimed" and \
@@ -525,6 +629,23 @@ class Server:
                     continue              # a prior claim chain owns it
                 if os.path.exists(self.result_path(sid)):
                     continue              # finished; shared store has it
+                if sid in cancels:
+                    # the dead replica ACKNOWLEDGED this cancel but
+                    # died before the barrier finalized it: honor it —
+                    # write the terminal record into the shared store
+                    # (reads keep working fleet-wide) and never adopt
+                    try:
+                        atomic_write_json(
+                            self.result_path(sid),
+                            cancelled_record(
+                                sid, r.get("tenant", "default"),
+                                cancels[sid],
+                                trace_id=r.get("trace"),
+                                deadline_ms=r.get("dl") or None,
+                                failed_over=True))
+                    except Exception:
+                        pass
+                    continue
                 with self._lock:
                     if sid in self.sessions:
                         continue          # idempotent takeover resume
@@ -542,6 +663,7 @@ class Server:
                     submitted_utc=r.get("utc", ""),
                     priority=int(r.get("priority", 0)),
                     failed_over=True,
+                    deadline_ms=r.get("dl") or None,
                     trace_id=r.get("trace") or new_trace_id())
                 with self._submit_lock:
                     if self._journal is None:
@@ -552,6 +674,7 @@ class Server:
                          "payload": sess.payload, "seq": 0,
                          "priority": sess.priority,
                          "utc": sess.submitted_utc, "fo": dead_rid,
+                         "dl": sess.deadline_ms,
                          "trace": sess.trace_id})
                     self.queue.offer(sess, force=True,
                                      priority=sess.priority)
@@ -633,6 +756,26 @@ class Server:
             priority = max(-9, min(9, int(body.get("priority") or 0)))
         except (TypeError, ValueError):
             return 400, {"error": "priority must be an integer"}, None
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms or None
+        else:
+            try:
+                deadline_ms = int(deadline_ms)
+                if deadline_ms <= 0:
+                    raise ValueError(deadline_ms)
+            except (TypeError, ValueError):
+                return 400, {"error": "deadline_ms must be a positive "
+                                      "integer (milliseconds)"}, None
+        # resource-pressure degradation (serve/overload.py): low disk /
+        # recent ENOSPC sheds NEW admissions while running sessions
+        # keep their pages and finish — accepting work we cannot
+        # durably journal or spill would fail it mid-run instead
+        pressure = self.disk.check()
+        if pressure:
+            self._note_shed(tenant, "disk")
+            return 503, {"error": f"degraded: {pressure}"}, \
+                {"Retry-After": 30}
         # per-tenant rate quota BEFORE the shared queue: a throttled
         # tenant's Retry-After reflects its OWN bucket, and its 429
         # never consumes shared queue capacity
@@ -642,6 +785,17 @@ class Server:
             return 429, {"error": f"tenant {tenant!r} over its "
                                   f"request rate"}, \
                 {"Retry-After": max(1, int(ra + 0.999))}
+        # SLO-burn shedding (serve/overload.py): a tenant burning its
+        # error budget in every window absorbs the backpressure FIRST —
+        # its expensive-profile submits shed with an honest per-tenant
+        # Retry-After, its cheap ones lose priority — before the shared
+        # queue's 429 starts hitting polite tenants
+        action, priority, shed_ra = self.shedder.decide(tenant, priority)
+        if action == "shed":
+            self._note_shed(tenant, "slo_burn")
+            return 429, {"error": f"tenant {tenant!r} is over its SLO "
+                                  f"error budget; new work is shed"}, \
+                {"Retry-After": max(1, int(shed_ra + 0.999))}
         with self._submit_lock:
             if self._journal is None:       # shutdown closed it
                 return 503, {"error": "shutting down"}, \
@@ -656,6 +810,7 @@ class Server:
             sess = Session(
                 sid=sid, tenant=tenant, payload=payload, fmt=fmt,
                 priority=priority, trace_id=new_trace_id(),
+                deadline_ms=deadline_ms,
                 submitted_utc=time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()))
             # the journal record lands BEFORE the queue sees the
@@ -669,7 +824,7 @@ class Server:
                 {"kind": "serve_submit", "sid": sid, "tenant": tenant,
                  "fmt": fmt, "payload": payload, "seq": self._seq,
                  "priority": priority, "utc": sess.submitted_utc,
-                 "trace": sess.trace_id})
+                 "dl": deadline_ms, "trace": sess.trace_id})
             if not self.queue.offer(sess, force=True,
                                     priority=priority):
                 # capacity is held by the submit lock, so the only way
@@ -687,7 +842,12 @@ class Server:
             with self._watch_lock:
                 self._trace_sids[sess.trace_id] = sid
         self._metric_admission("accepted", tenant)
+        # an admitted submit ends any shed episode for this tenant —
+        # the NEXT shed is a fresh rising edge worth a journal record
+        self._clear_shed_edge(tenant, "slo_burn")
+        self._clear_shed_edge(tenant, "disk")
         return 202, {"id": sid, "state": QUEUED, "tenant": tenant,
+                     "deadline_ms": deadline_ms,
                      "trace_id": sess.trace_id}, None
 
     # Retry-After floor for a replica with NO draining capacity (paused
@@ -707,6 +867,57 @@ class Server:
         per = max(0.05, self._ewma_wall) / workers
         return max(1, int(self.queue.depth() * per + 0.5))
 
+    # a shed more than this long after the previous one for the same
+    # (tenant, reason) is a NEW episode and journals a fresh rising
+    # edge — a tenant whose clients gave up (so no admit ever cleared
+    # the edge) must not have its next week's episode go unrecorded
+    _SHED_EPISODE_S = 600.0
+
+    def _note_shed(self, tenant: str, reason: str) -> None:
+        """One shed decision: count it (every shed response bumps
+        ``mrtpu_serve_shed_total{tenant,reason}``) and journal the
+        RISING EDGE per (tenant, reason) episode — post-mortems need
+        "when did shedding start", not one fsync per rejected
+        request."""
+        try:
+            from ..obs.metrics import get_registry
+            get_registry().counter(
+                "mrtpu_serve_shed_total",
+                "admissions shed by the self-protection plane "
+                "(reason: slo_burn/disk)",
+                ("tenant", "reason")).inc(tenant=tenant, reason=reason)
+        except Exception:
+            pass
+        key = f"{tenant}|{reason}"
+        now = time.monotonic()
+        with self._shed_lock:
+            last = self._shed_edges.get(key)
+            if len(self._shed_edges) > 512 and last is None:
+                # tenant names come from request bodies: expire
+                # finished episodes (and, failing that, everything) so
+                # a client cycling names against a degraded daemon
+                # can't grow this
+                self._shed_edges = {
+                    k: t for k, t in self._shed_edges.items()
+                    if now - t < self._SHED_EPISODE_S}
+                if len(self._shed_edges) > 512:
+                    self._shed_edges.clear()
+            self._shed_edges[key] = now
+        if last is not None and now - last < self._SHED_EPISODE_S:
+            return              # same episode: already journaled
+        with self._submit_lock:
+            if self._journal is not None:
+                try:
+                    self._journal.append({"kind": "serve_shed",
+                                          "tenant": tenant,
+                                          "reason": reason})
+                except (ValueError, OSError):
+                    pass    # a full disk must not turn shedding into 500s
+
+    def _clear_shed_edge(self, tenant: str, reason: str) -> None:
+        with self._shed_lock:
+            self._shed_edges.pop(f"{tenant}|{reason}", None)
+
     def _metric_admission(self, outcome: str, tenant: str = "default"
                           ) -> None:
         try:
@@ -719,6 +930,170 @@ class Server:
                                            tenant=tenant)
         except Exception:
             pass
+
+    # -- cancellation (DELETE /v1/jobs/<id>) -------------------------------
+    def cancel(self, sid: str, reason: str = "client") -> tuple:
+        """→ (code, body).  QUEUED sessions finalize as ``cancelled``
+        right here (they never run); RUNNING ones get their request
+        account flagged and stop cooperatively at the next op barrier
+        (obs/context.barrier_check).  A cancel landing after the
+        terminal record is a 409 no-op — it never touches the result
+        (doc/serve.md#deadlines-and-cancel)."""
+        with self._lock:
+            sess = self.sessions.get(sid)
+            if sess is None:
+                return 404, {"error": f"no session {sid!r}"}
+            st = sess.state
+            if st in TERMINAL:
+                return 409, {"error": f"session {sid!r} already "
+                                      f"{st}; cancel is a no-op"}
+            if st == QUEUED:
+                if sess.cancel_requested is None:
+                    sess.cancel_requested = reason
+                    claim = True
+                else:
+                    claim = False     # an earlier cancel owns finalize
+            else:                     # RUNNING
+                claim = False
+                first = sess.cancel_requested is None
+                sess.cancel_requested = sess.cancel_requested or reason
+                acct = sess.account
+        if st == QUEUED:
+            if claim:
+                self._finalize_cancelled(sess, reason)
+            return 202, {"id": sid, "state": CANCELLED,
+                         "cancel_reason": reason}
+        # RUNNING: journal the acknowledged cancel BEFORE arming the
+        # flag — a kill -9 between this 202 and the session's next
+        # barrier must not resurrect and complete a session its client
+        # was told is cancelling (recovery finalizes serve_cancel'd
+        # sids as cancelled instead of re-queueing them).  Only the
+        # FIRST cancel journals: a client hammering DELETE while the
+        # barrier approaches must not grow the journal one fsync per
+        # request
+        if first:
+            with self._submit_lock:
+                if self._journal is not None:
+                    try:
+                        self._journal.append(
+                            {"kind": "serve_cancel", "sid": sid,
+                             "reason": reason, "trace": sess.trace_id})
+                    except (ValueError, OSError):
+                        pass
+        # arm the account (it may lag sess.state by a few lines in
+        # run_session — cancel_requested covers that window:
+        # run_session re-checks it after PUBLISHING the account, so one
+        # side always sees the other)
+        if acct is not None:
+            acct.cancel(reason)
+        self._push_event(sid, {"event": "status", "id": sid,
+                               "state": "cancelling",
+                               "cancel_reason": reason})
+        return 202, {"id": sid, "state": "cancelling",
+                     "cancel_reason": reason}
+
+    def _finalize_cancelled(self, sess: Session, reason: str) -> None:
+        """Terminal bookkeeping for a session cancelled BEFORE it ran:
+        the ``serve_cancel`` intent record FIRST (a crash anywhere past
+        it recovers to ``cancelled``, never to a resurrected run that
+        overwrites this result), then the durable result, then the
+        ``serve_done`` record, then the state flip — same ordering
+        discipline as the worker path."""
+        sess.cancel_reason = reason
+        sess.error = f"cancelled ({reason})"
+        with self._submit_lock:
+            if self._journal is not None:
+                try:
+                    self._journal.append(
+                        {"kind": "serve_cancel", "sid": sess.sid,
+                         "reason": reason, "trace": sess.trace_id})
+                except (ValueError, OSError):
+                    pass
+        try:
+            atomic_write_json(
+                self.result_path(sess.sid),
+                cancelled_record(sess.sid, sess.tenant, reason,
+                                 trace_id=sess.trace_id,
+                                 deadline_ms=sess.deadline_ms,
+                                 failed_over=sess.failed_over))
+        except Exception:
+            pass
+        with self._submit_lock:
+            if self._journal is not None:
+                try:
+                    self._journal.append(
+                        {"kind": "serve_done", "sid": sess.sid,
+                         "status": CANCELLED, "trace": sess.trace_id})
+                except (ValueError, OSError):
+                    pass
+        sess.state = CANCELLED
+        sess.finished_ts = time.time()
+        self._metric_cancel(sess.tenant, reason)
+        self._metric_session(sess)
+        self._push_event(sess.sid, {"event": "status", **sess.summary()})
+
+    def _metric_cancel(self, tenant: str, reason: str) -> None:
+        try:
+            from ..obs.metrics import get_registry
+            get_registry().counter(
+                "mrtpu_serve_cancel_total",
+                "sessions cancelled, by reason "
+                "(client/deadline/stall)",
+                ("tenant", "reason")).inc(tenant=tenant, reason=reason)
+        except Exception:
+            pass
+
+    # -- hung-session watchdog ---------------------------------------------
+    def _stall_loop(self) -> None:
+        """MRTPU_SERVE_STALL armed: flag any RUNNING session with no
+        barrier progress for that long (a wedged collective, a hung
+        input read), arm the flight recorder so the forensic ring is
+        already collecting, and — under MRTPU_SERVE_STALL_CANCEL=1 —
+        cancel it so the worker comes back.  The flag clears itself
+        when progress resumes: a slow op is not a hang."""
+        interval = max(0.05, min(self.stall_s / 4.0, 5.0))
+        while not self._stopped.wait(interval):
+            try:
+                self._stall_scan(time.monotonic())
+            except Exception:
+                pass    # the watchdog must never take the daemon down
+
+    def _stall_scan(self, now: float) -> None:
+        """One watchdog pass (split from the loop so tests drive it
+        with a synthetic clock)."""
+        with self._lock:
+            running = [s for s in self.sessions.values()
+                       if s.state == RUNNING and s.account is not None]
+        for sess in running:
+            acct = sess.account
+            idle = now - acct.last_barrier
+            if idle < self.stall_s:
+                sess.stalled = False
+                continue
+            if sess.stalled:
+                continue              # already flagged this episode
+            sess.stalled = True
+            self.stall_count += 1
+            try:
+                from ..obs import flight as _flight
+                _flight.enable()
+            except Exception:
+                pass
+            try:
+                from ..obs.metrics import get_registry
+                get_registry().counter(
+                    "mrtpu_serve_stalled_total",
+                    "sessions flagged by the stall watchdog (no "
+                    "barrier progress for MRTPU_SERVE_STALL)",
+                    ("tenant",)).inc(tenant=sess.tenant)
+            except Exception:
+                pass
+            self._push_event(sess.sid, {
+                "event": "stalled", "id": sess.sid,
+                "idle_s": round(idle, 3),
+                "cancelling": self.stall_cancel})
+            if self.stall_cancel:
+                acct.cancel("stall")
 
     # -- session TTL / GC --------------------------------------------------
     def _gc_files(self, sid: str) -> None:
@@ -743,7 +1118,7 @@ class Server:
         expired: List[Session] = []
         with self._lock:
             for sess in self.sessions.values():
-                if sess.state in (DONE, FAILED) and \
+                if sess.state in TERMINAL and \
                         sess.finished_ts is not None and \
                         now - sess.finished_ts >= self.ttl_s:
                     expired.append(sess)
@@ -802,6 +1177,18 @@ class Server:
                 fleet_mod.note_fenced_drop(self.rid)
                 continue
             with self._lock:
+                if sess.cancel_requested is not None and \
+                        sess.state != RUNNING:
+                    # cancelled while QUEUED: the DELETE handler owns
+                    # (or already finished) the terminal bookkeeping —
+                    # executing it now would be the double run the 202
+                    # "state: cancelled" promised against
+                    continue
+                # the RUNNING flip happens UNDER the lock so a
+                # concurrent DELETE always sees either "still queued"
+                # (it finalizes, we skip above) or "running" (it arms
+                # the account) — never a gap between the two
+                sess.state = RUNNING
                 self._active += 1
             self._push_event(sess.sid,
                              {"event": "status", "id": sess.sid,
@@ -811,6 +1198,8 @@ class Server:
                 result = run_session(self, sess)
             except Exception as e:    # run_session already shields; belt
                 sess.error = f"{type(e).__name__}: {e}"
+                self.disk.note_error(e)   # a result-write ENOSPC
+                #                           must flip us degraded
                 try:
                     atomic_write_json(
                         self.result_path(sess.sid),
@@ -826,6 +1215,17 @@ class Server:
                     self._active -= 1
             self._ewma_wall = 0.7 * self._ewma_wall + \
                 0.3 * float(sess.wall_s or 1.0)
+            if sess.state == CANCELLED:
+                self._metric_cancel(sess.tenant,
+                                    sess.cancel_reason or "client")
+            # cost-profile evidence (serve/overload.py): what the SLO
+            # shedder ranks expensive-vs-cheap by, and what the mesh
+            # autoscaler sizes the next session's width from
+            acct0 = sess.account
+            if acct0 is not None:
+                self.profiles.record(
+                    sess.tenant, sess.wall_s or 0.0,
+                    acct0.exchange_sent + acct0.exchange_pad)
             # completion record follows the durable result file.  A
             # worker draining past shutdown's join timeout may find the
             # journal closed — the missing done record only costs one
@@ -936,7 +1336,7 @@ class Server:
                 yield line({"event": "error",
                             "error": f"no session {sid!r}"})
                 return
-            if sess.state in (DONE, FAILED):
+            if sess.state in TERMINAL:
                 # already finished: replay the durable profile, THEN
                 # the terminal status — same order as the live path
                 # (worker pushes profile before the final status), so
@@ -962,7 +1362,7 @@ class Server:
                     continue
                 yield line(item)
                 if item.get("event") == "status" and \
-                        item.get("state") in (DONE, FAILED):
+                        item.get("state") in TERMINAL:
                     return
         finally:
             with self._watch_lock:
@@ -1050,11 +1450,44 @@ class Server:
                 "gc": {"ttl_s": self.ttl_s, "swept": self.gc_count},
                 "mesh": {"nprocs": self._mesh_width()},
                 "plan": cache_stats(),
+                # the self-protection plane (doc/serve.md): auth arming,
+                # shed/deprioritize counts, cost evidence, disk
+                # pressure, watchdog and autoscaler state
+                "overload": {
+                    "auth": self.auth.snapshot(),
+                    "shed": self.shedder.snapshot(),
+                    "profiles": self.profiles.snapshot(),
+                    "disk": self.disk.snapshot(),
+                    "stall": {"stall_s": self.stall_s,
+                              "cancel": self.stall_cancel,
+                              "flagged": self.stall_count},
+                    "deadline_default_ms": self.default_deadline_ms,
+                    "autoscale": self.autoscaler.snapshot()},
                 "draining": self._draining, "paused": self.paused,
                 "workers": len(self._workers), "port": self.port,
                 "state_dir": self.state_dir}
 
     # -- HTTP routing (obs/httpd.register_routes handler) ------------------
+    def _session_tenant(self, sid: str) -> Optional[str]:
+        with self._lock:
+            sess = self.sessions.get(sid)
+        return sess.tenant if sess else None
+
+    def _authz(self, ident: Optional[str],
+               tenant: Optional[str] = None,
+               admin: bool = False) -> Optional[tuple]:
+        """Route-level auth gate over the ONE token resolution the
+        handler already did: None = allowed, else a full response tuple
+        (401 missing/invalid token, 403 out-of-tenant or non-admin
+        operator verb) — decided BEFORE any journal write or queue
+        mutation (serve/auth.py)."""
+        code, err = self.auth.gate_ident(ident, tenant=tenant,
+                                         admin=admin)
+        if not code:
+            return None
+        extra = {"WWW-Authenticate": "Bearer"} if code == 401 else None
+        return code, err, "application/json", extra
+
     def _handle(self, method: str, path: str, body: bytes,
                 headers: dict) -> tuple:
         import json
@@ -1062,6 +1495,13 @@ class Server:
         if len(parts) < 2 or parts[0] != "v1":
             return 404, {"error": "not found"}, "application/json", None
         rest = parts[1:]
+        # every /v1/ request needs a VALID token when auth is armed
+        # (tenant scoping per route below); the telemetry plane
+        # (/metrics, /healthz) stays open — doc/serve.md#tenant-auth
+        ident = self.auth.identify(headers) if self.auth.armed else None
+        if self.auth.armed and ident is None:
+            return 401, {"error": "missing or invalid bearer token"}, \
+                "application/json", {"WWW-Authenticate": "Bearer"}
         if method == "POST" and rest == ["jobs"]:
             try:
                 obj = json.loads(body.decode() or "{}")
@@ -1070,13 +1510,51 @@ class Server:
             except (ValueError, UnicodeDecodeError) as e:
                 return 400, {"error": f"bad JSON body: {e}"}, \
                     "application/json", None
+            if ident is not None and ident != "*" \
+                    and not obj.get("tenant"):
+                obj["tenant"] = ident     # the token names the tenant
+            denied = self._authz(
+                ident, tenant=str(obj.get("tenant") or "default"))
+            if denied:
+                return denied
             code, out, extra = self.submit(obj)
             return code, out, "application/json", extra
+        if method == "DELETE" and len(rest) == 2 and rest[0] == "jobs":
+            owner = self._session_tenant(rest[1])
+            if owner is None:
+                return 404, {"error": f"no session {rest[1]!r}"}, \
+                    "application/json", None
+            denied = self._authz(ident, tenant=owner)
+            if denied:
+                if denied[0] == 403:
+                    # foreign sid reads as NONEXISTENT: sids are
+                    # sequential, so 403-vs-404 would be an existence
+                    # oracle over other tenants' session volume
+                    return 404, {"error": f"no session {rest[1]!r}"}, \
+                        "application/json", None
+                return denied
+            code, out = self.cancel(rest[1])
+            return code, out, "application/json", None
         if method == "GET" and rest == ["jobs"]:
             with self._lock:
                 out = [self.sessions[sid].summary()
                        for sid in self._order]
+            if ident is not None and ident != "*":
+                # a tenant token lists its OWN sessions only
+                out = [s for s in out if s.get("tenant") == ident]
             return 200, {"jobs": out}, "application/json", None
+        if method == "GET" and len(rest) in (2, 3) and rest[0] == "jobs":
+            # tenant tokens read only their own sessions (admin: all);
+            # a foreign sid answers 404, not 403 — no existence oracle
+            owner = self._session_tenant(rest[1])
+            if owner is not None:
+                denied = self._authz(ident, tenant=owner)
+                if denied:
+                    if denied[0] == 403:
+                        return 404, {"error": f"no session "
+                                              f"{rest[1]!r}"}, \
+                            "application/json", None
+                    return denied
         if method == "GET" and len(rest) == 2 and rest[0] == "jobs":
             st = self.status(rest[1])
             if st is None:
@@ -1101,6 +1579,12 @@ class Server:
             return 200, self._events_stream(rest[1]), \
                 "application/x-ndjson", None
         if method == "GET" and rest == ["slo"]:
+            # burn rates cover EVERY tenant — operator surface, like
+            # /v1/stats below (a tenant token must not read its
+            # neighbors' cost profiles or traffic shape)
+            denied = self._authz(ident, admin=True)
+            if denied:
+                return denied
             from ..obs import slo as _slo
             eng = _slo.get_engine()
             if eng is None:
@@ -1112,11 +1596,22 @@ class Server:
             eng.tick(force=True)
             return 200, eng.snapshot(), "application/json", None
         if method == "GET" and rest == ["stats"]:
+            # stats spans every tenant (page accounts, cost profiles,
+            # shed state) — admin-only when auth is armed
+            denied = self._authz(ident, admin=True)
+            if denied:
+                return denied
             return 200, self.stats(), "application/json", None
         if method == "POST" and rest == ["drain"]:
+            denied = self._authz(ident, admin=True)
+            if denied:
+                return denied
             self.drain()
             return 200, {"draining": True}, "application/json", None
         if method == "POST" and rest == ["shutdown"]:
+            denied = self._authz(ident, admin=True)
+            if denied:
+                return denied
             # respond first, stop after: the stop path drains in-flight
             # HTTP handlers, and THIS handler is one of them
             threading.Thread(target=self._deferred_shutdown,

@@ -40,6 +40,7 @@ import uuid
 from typing import Iterable, List, Optional, Tuple
 
 from ..utils.env import env_flag, env_knob
+from .auth import TokenAuth
 from .fleet import FleetMember, enable_fleet_metrics, owner_of, ring_route
 from .session import atomic_write_json
 
@@ -60,6 +61,12 @@ class Router:
         self.redirect_reads = redirect_reads if redirect_reads is not None \
             else env_flag("MRTPU_ROUTER_REDIRECT", False)
         self.proxy_timeout = proxy_timeout
+        # the SAME token set the replicas arm (one MRTPU_SERVE_TOKENS
+        # file fleet-wide): proxied paths are enforced by the replica
+        # that answers, but the shared-result-store FALLBACKS answer
+        # from disk with no replica in the loop — the router must
+        # enforce there itself or a dead owner becomes an auth bypass
+        self.auth = TokenAuth()
         self._listener = None
         self._lock = threading.Lock()
 
@@ -92,8 +99,18 @@ class Router:
             pass
 
     def _health(self) -> str:
-        """The router is ready when it can route somewhere."""
-        return "ok" if self.fleet.healthy() else "degraded"
+        """The router is ready when it can route somewhere; otherwise
+        it AGGREGATES the replica states so "every replica is shedding
+        under resource pressure" reads ``degraded`` (one curl tells the
+        operator which runbook page to open) while "every lease
+        expired" reads ``unavailable``."""
+        if self.fleet.healthy():
+            return "ok"
+        states = {self.fleet.replica_state(rid, lease)
+                  for rid, lease in self.fleet.peers().items()}
+        if "degraded" in states:
+            return "degraded"
+        return "unavailable"
 
     # -- plumbing ----------------------------------------------------------
     def _metric(self, outcome: str) -> None:
@@ -123,18 +140,34 @@ class Router:
         return 503, {"error": "no fleet replica holds a valid lease"}, \
             "application/json", {"Retry-After": ra}
 
-    def _proxy(self, rid: str, method: str, path: str,
-               body: bytes) -> Optional[tuple]:
+    @staticmethod
+    def _fwd_headers(headers: Optional[dict],
+                     body: bytes = b"") -> dict:
+        """Headers a proxied hop forwards VERBATIM: the bearer token
+        (replicas enforce auth — the router holds no secrets) plus the
+        content type.  Everything else (Host, connection management)
+        belongs to the router's own hop."""
+        out = {"Content-Type": "application/json"} if body else {}
+        for k, v in (headers or {}).items():
+            if str(k).lower() == "authorization":
+                out["Authorization"] = v
+        return out
+
+    def _proxy(self, rid: str, method: str, path: str, body: bytes,
+               headers: Optional[dict] = None) -> Optional[tuple]:
         """One proxied hop to ``rid``; None when the replica did not
         answer at the TCP level (caller reroutes or falls back).  HTTP
-        error codes pass through faithfully, Retry-After included."""
+        error codes — 401/403/429 included — pass through VERBATIM,
+        body and Retry-After untouched: the client must see the
+        replica's own story, not a router paraphrase."""
         port = self._replica_port(rid)
         if port is None:
             return None
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}{path}",
-            data=body if method == "POST" else None, method=method,
-            headers={"Content-Type": "application/json"} if body else {})
+            data=body if method in ("POST", "DELETE") and body else None,
+            method=method,
+            headers=self._fwd_headers(headers, body))
         try:
             with urllib.request.urlopen(
                     req, timeout=self.proxy_timeout) as r:
@@ -153,15 +186,18 @@ class Router:
         except (urllib.error.URLError, OSError):
             return None
 
-    def _proxy_stream(self, rid: str, path: str) -> Optional[Iterable]:
+    def _proxy_stream(self, rid: str, path: str,
+                      headers: Optional[dict] = None
+                      ) -> Optional[Iterable]:
         """Pass-through for the /events NDJSON stream: yield the
         replica's lines as they arrive (the router adds no buffering)."""
         port = self._replica_port(rid)
         if port is None:
             return None
         try:
-            resp = urllib.request.urlopen(
-                f"http://127.0.0.1:{port}{path}", timeout=120.0)
+            resp = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                headers=self._fwd_headers(headers)), timeout=120.0)
         except (urllib.error.URLError, OSError):
             return None
 
@@ -172,6 +208,25 @@ class Router:
         return gen()
 
     # -- result-store fallback ---------------------------------------------
+    def _auth_fallback(self, headers: Optional[dict],
+                       res: dict) -> Optional[tuple]:
+        """Auth for answers served straight from the shared result
+        store (no replica in the loop to enforce): same decision a
+        replica would make — the stored record's tenant scopes it."""
+        code, err = self.auth.gate(dict(headers or {}),
+                                   tenant=str(res.get("tenant")
+                                              or "default"))
+        if not code:
+            return None
+        if code == 403:
+            # match the daemons: a foreign sid reads as nonexistent
+            # (403-vs-404 would be an existence oracle)
+            return 404, {"error": f"no session "
+                                  f"{res.get('id')!r}"}, \
+                "application/json", None
+        return code, err, "application/json", \
+            {"WWW-Authenticate": "Bearer"}
+
     def _stored_result(self, sid: str) -> Optional[dict]:
         try:
             with open(os.path.join(self.fleet_dir, "results",
@@ -214,21 +269,49 @@ class Router:
             return 404, {"error": "not found"}, "application/json", None
         rest = parts[1:]
         if method == "POST" and rest == ["jobs"]:
-            return self._route_submit(body)
+            return self._route_submit(body, headers)
         if rest == ["stats"] and method == "GET":
-            return self._fleet_stats()
+            return self._fleet_stats(headers)
         if rest == ["slo"] and method == "GET":
-            return self._any_healthy(method, path, body)
+            return self._any_healthy(method, path, body, headers)
         if rest == ["jobs"] and method == "GET":
-            return self._merged_jobs()
+            return self._merged_jobs(headers)
         if method == "POST" and rest[0] in ("drain", "shutdown") \
                 and len(rest) == 1:
-            return self._broadcast(method, path, body)
+            return self._broadcast(method, path, body, headers)
+        if rest[0] == "jobs" and len(rest) == 2 and method == "DELETE":
+            return self._route_cancel(rest[1], path, headers)
         if rest[0] == "jobs" and len(rest) in (2, 3) and method == "GET":
-            return self._route_read(rest, path)
+            return self._route_read(rest, path, headers)
         return 404, {"error": "not found"}, "application/json", None
 
-    def _route_submit(self, body: bytes) -> tuple:
+    def _route_cancel(self, sid: str, path: str,
+                      headers: Optional[dict]) -> tuple:
+        """``DELETE /v1/jobs/<sid>``: walk the claim chain like a read
+        and proxy the cancel to whichever live replica knows the
+        session.  A 404 from one candidate falls through to the next;
+        when nobody live knows it but the shared store holds a terminal
+        result, answer the daemon's own 409 no-op contract."""
+        for owner in self._owner_candidates(sid):
+            out = self._proxy(owner, "DELETE", path, b"", headers)
+            if out is not None and out[0] != 404:
+                self._metric("proxied")
+                return out
+        res = self._stored_result(sid)
+        if res is not None:
+            denied = self._auth_fallback(headers, res)
+            if denied:
+                return denied
+            return 409, {"error": f"session {sid!r} already "
+                                  f"{res.get('status')}; cancel is a "
+                                  f"no-op"}, "application/json", None
+        if not self.fleet.healthy():
+            return self._unavailable()
+        return 404, {"error": f"no session {sid!r} reachable"}, \
+            "application/json", None
+
+    def _route_submit(self, body: bytes,
+                      headers: Optional[dict] = None) -> tuple:
         try:
             obj = json.loads(body.decode() or "{}")
             if not isinstance(obj, dict):
@@ -246,7 +329,7 @@ class Router:
         first = ring_route(key, healthy, vnodes=self.vnodes)
         order = [first] + [r for r in healthy if r != first]
         for i, rid in enumerate(order):
-            out = self._proxy(rid, "POST", "/v1/jobs", body)
+            out = self._proxy(rid, "POST", "/v1/jobs", body, headers)
             if out is None:
                 continue        # dead mid-route: next healthy replica
             code, payload, ctype, extra = out
@@ -256,7 +339,8 @@ class Router:
             return code, payload, ctype, extra
         return self._unavailable()
 
-    def _route_read(self, rest: List[str], path: str) -> tuple:
+    def _route_read(self, rest: List[str], path: str,
+                    headers: Optional[dict] = None) -> tuple:
         sid = rest[1]
         sub = rest[2] if len(rest) == 3 else ""
         candidates = self._owner_candidates(sid)
@@ -274,13 +358,13 @@ class Router:
                 {"Location": f"http://127.0.0.1:{port}{path}"}
         for owner in candidates:
             if sub == "events":
-                stream = self._proxy_stream(owner, path)
+                stream = self._proxy_stream(owner, path, headers)
                 if stream is not None:
                     self._metric("proxied")
                     return 200, stream, "application/x-ndjson", \
                         {"X-Mrtpu-Replica": owner}
             else:
-                out = self._proxy(owner, "GET", path, b"")
+                out = self._proxy(owner, "GET", path, b"", headers)
                 # a live candidate may not know this sid (a claimant
                 # never adopts sessions that FINISHED before their
                 # owner died; a rejoined minter dropped its claimed
@@ -299,6 +383,9 @@ class Router:
             return 404, {"error": f"no session {sid!r} reachable "
                                   f"(owner down, no stored result)"}, \
                 "application/json", None
+        denied = self._auth_fallback(headers, res)
+        if denied:
+            return denied
         self._metric("fallback")
         if sub == "result":
             return 200, res, "application/json", None
@@ -327,18 +414,24 @@ class Router:
             return 200, iter(lines), "application/x-ndjson", None
         return 200, summary, "application/json", None
 
-    def _any_healthy(self, method: str, path: str, body: bytes) -> tuple:
+    def _any_healthy(self, method: str, path: str, body: bytes,
+                     headers: Optional[dict] = None) -> tuple:
         for rid in self.fleet.healthy():
-            out = self._proxy(rid, method, path, body)
+            out = self._proxy(rid, method, path, body, headers)
             if out is not None:
                 return out
         return self._unavailable()
 
-    def _merged_jobs(self) -> tuple:
+    def _merged_jobs(self, headers: Optional[dict] = None) -> tuple:
         jobs: List[dict] = []
         seen = set()
         for rid in self.fleet.healthy():
-            out = self._proxy(rid, "GET", "/v1/jobs", b"")
+            out = self._proxy(rid, "GET", "/v1/jobs", b"", headers)
+            if out is not None and out[0] in (401, 403):
+                # a replica refused the credentials: pass its answer
+                # through verbatim — 200 {"jobs": []} would disguise a
+                # bad token as an empty fleet
+                return out
             if out is None or out[0] != 200:
                 continue
             try:
@@ -350,14 +443,24 @@ class Router:
                 continue
         return 200, {"jobs": jobs}, "application/json", None
 
-    def _fleet_stats(self) -> tuple:
+    def _fleet_stats(self, headers: Optional[dict] = None) -> tuple:
+        # the daemons gate /v1/stats admin-only; the router's SELF-
+        # composed topology answer (replica ids/ports/epochs/ring)
+        # must hold the same line — no replica is in the loop to
+        # enforce it for us
+        if self.auth.armed:
+            code, err = self.auth.gate(dict(headers or {}), admin=True)
+            if code:
+                extra = {"WWW-Authenticate": "Bearer"} \
+                    if code == 401 else None
+                return code, err, "application/json", extra
         replicas = {}
         for rid, lease in sorted(self.fleet.peers().items()):
             state = self.fleet.replica_state(rid, lease)
             row = {"state": state, "port": lease.get("port"),
                    "epoch": lease.get("epoch")}
-            if state in ("ready", "draining"):
-                out = self._proxy(rid, "GET", "/v1/stats", b"")
+            if state in ("ready", "draining", "degraded"):
+                out = self._proxy(rid, "GET", "/v1/stats", b"", headers)
                 if out is not None and out[0] == 200:
                     try:
                         row["stats"] = json.loads(out[1].decode())
@@ -368,12 +471,13 @@ class Router:
                      "healthy": self.fleet.healthy(),
                      "replicas": replicas}, "application/json", None
 
-    def _broadcast(self, method: str, path: str, body: bytes) -> tuple:
+    def _broadcast(self, method: str, path: str, body: bytes,
+                   headers: Optional[dict] = None) -> tuple:
         out = {}
         for rid, lease in sorted(self.fleet.peers().items()):
             if self.fleet.expired(lease):
                 continue
-            got = self._proxy(rid, method, path, body)
+            got = self._proxy(rid, method, path, body, headers)
             out[rid] = None if got is None else got[0]
         if not out:
             return self._unavailable()

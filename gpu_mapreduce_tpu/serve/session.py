@@ -26,9 +26,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from ..core.runtime import MRError, page_account_scope
+from ..core.runtime import CancelledError, MRError, page_account_scope
 
-QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+QUEUED, RUNNING, DONE, FAILED, CANCELLED = \
+    "queued", "running", "done", "failed", "cancelled"
+# the states a session never leaves (and the only ones whose result
+# files exist): terminal-ness has ONE definition so a new state can't
+# silently leak out of half the checks
+TERMINAL = (DONE, FAILED, CANCELLED)
 
 # result files stay fetchable but must not become a covert bulk store:
 # bigger payloads ship as sha256 + size only
@@ -89,6 +94,14 @@ class Session:
     #                               claimed journal (serve/fleet.py)
     finished_ts: Optional[float] = None   # TTL GC clock (epoch seconds)
     trace_id: str = ""            # request trace context (obs/context)
+    deadline_ms: Optional[int] = None     # execution budget (submit body
+    #                               `deadline_ms`; rides the journal)
+    cancel_requested: Optional[str] = None  # reason, set by DELETE /
+    #                               watchdog before the account exists
+    cancel_reason: Optional[str] = None   # why a CANCELLED session died
+    stalled: bool = False         # watchdog: no barrier progress for
+    #                               MRTPU_SERVE_STALL seconds
+    mesh_width: Optional[int] = None      # autoscaler-chosen width
     account: Optional[object] = field(default=None, repr=False,
                                       compare=False)   # live profile
 
@@ -100,6 +113,9 @@ class Session:
                 "resumed": self.resumed, "priority": self.priority,
                 "resharded": self.resharded,
                 "failed_over": self.failed_over,
+                "deadline_ms": self.deadline_ms,
+                "cancel_reason": self.cancel_reason,
+                "stalled": self.stalled,
                 "trace_id": self.trace_id}
 
 
@@ -161,6 +177,23 @@ def _collect_files(outdir: str) -> dict:
     return out
 
 
+def cancelled_record(sid: str, tenant: str, reason: str,
+                     trace_id: Optional[str] = None,
+                     deadline_ms: Optional[int] = None,
+                     failed_over: bool = False) -> dict:
+    """The terminal result record of a session cancelled WITHOUT ever
+    running — one builder for the DELETE-while-queued finalize, the
+    recovery finalize, and the fleet-takeover store write, so the
+    record shape cannot drift between them (a session cancelled
+    mid-run gets its full record from run_session instead)."""
+    return {"id": sid, "tenant": tenant, "status": CANCELLED,
+            "error": f"cancelled ({reason})",
+            "output": "", "files": {}, "mrs": {},
+            "meta": {"trace_id": trace_id, "cancel_reason": reason,
+                     "deadline_ms": deadline_ms,
+                     "failed_over": failed_over, "ran": False}}
+
+
 def atomic_write_json(path: str, obj: dict) -> None:
     """tmp + fsync + rename: a crash mid-write leaves only ``*.tmp``,
     never a torn result a restarted daemon would serve."""
@@ -197,7 +230,16 @@ def run_session(server, sess: Session) -> dict:
     os.makedirs(spill, exist_ok=True)
 
     screen = _CappedScreen()
-    om = ObjectManager(comm=server.comm)
+    # mesh autoscaling (serve/autoscale.py): the daemon may hand this
+    # session a NARROW sub-mesh sized from its tenant's profiled
+    # exchange volume; plain servers (and tests driving run_session
+    # directly) fall back to the daemon's full comm
+    session_comm = getattr(server, "session_comm", None)
+    if session_comm is not None:
+        comm, sess.mesh_width = session_comm(sess)
+    else:
+        comm = server.comm
+    om = ObjectManager(comm=comm)
     defaults = server.budgets.defaults_for(sess.tenant, spill)
     if server.budgets.pages > 0:
         # an armed tenant budget is PINNED: the script's own `set`
@@ -230,11 +272,36 @@ def run_session(server, sess: Session) -> dict:
     req = obs_context.RequestAccount(trace_id=sess.trace_id,
                                      tenant=sess.tenant,
                                      label=f"serve:{sess.sid}")
+    # deadlines + cancellation (doc/serve.md#deadlines-and-cancel):
+    # the account is the flag the barrier sites check.  deadline_ms
+    # budgets EXECUTION time (from here), not queue time — a replayed
+    # session after a crash must not be dead on arrival.
+    if sess.deadline_ms:
+        req.set_deadline(sess.deadline_ms / 1000.0)
     sess.account = req          # the /v1/jobs/<id>/profile live view
+    # re-check AFTER publishing the account (store-then-load on both
+    # sides): a concurrent DELETE either saw the account just published
+    # (it arms the flag itself) or set cancel_requested before this
+    # load (we arm it here) — either way the cancel is never lost
+    if sess.cancel_requested:
+        req.cancel(sess.cancel_requested)
     sess.state = RUNNING
     sess.resumed = _resumable(sdir)
+    # autoscaler live promotion: if this session runs NARROW and its
+    # observed exchange volume outgrows the prediction, reshard wide at
+    # the next command boundary (oink post_cmd hook)
+    autoscaler = getattr(server, "autoscaler", None)
+    if autoscaler is not None and sess.mesh_width is not None:
+        def _note_promoted() -> None:
+            sess.resharded = True
+            sess.mesh_width = autoscaler.full_width
+        hook = autoscaler.promote_hook(req, sess.mesh_width,
+                                       on_promote=_note_promoted)
+        if hook is not None:
+            script.post_cmd.append(hook)
     t0 = time.perf_counter()
     error: Optional[str] = None
+    cancelled: Optional[str] = None
     try:
         with page_account_scope(acct), obs_context.use(req):
             if sess.resumed:
@@ -258,14 +325,42 @@ def run_session(server, sess: Session) -> dict:
             mrs = {name: (cur.named[name].kv.nkv
                           if cur.named[name].kv is not None else None)
                    for name in sorted(cur.named)}
+    except CancelledError as e:
+        # a cooperative stop at an op barrier: NOT a failure.  The
+        # journal + auto-checkpoints written so far stay in the session
+        # dir, so the work is resumable at the exact boundary it
+        # stopped (doc/serve.md#deadlines-and-cancel)
+        cancelled = e.reason
+        sess.cancel_reason = e.reason
+        mrs = {}
+        # the cancel may have tripped with DEFERRED stages recorded
+        # (fuse=1): discard them — the release path below reads kv/kmv
+        # (flush barriers) AFTER disarm_cancel, and a cancelled chain
+        # must never dispatch from its own cleanup
+        try:
+            cur = script.obj
+            for m in list(cur.named.values()) + list(cur._temps):
+                m.discard_plan()
+        except Exception:
+            pass
     except Exception as e:       # noqa: BLE001 — session isolation
         error = f"{type(e).__name__}: {e}"
         mrs = {}
+        # resource-pressure latch (serve/overload.py): an ENOSPC in
+        # this session's failure chain flips the daemon DEGRADED so it
+        # sheds new admissions instead of failing more sessions the
+        # same way
+        disk = getattr(server, "disk", None)
+        if disk is not None:
+            disk.note_error(e)
     finally:
         # sessions are one-shot: release every frame the namespace
         # still holds (inside the account scope callers of free() run
         # on this thread, so the tenant gauge deflates too — and inside
-        # the request context, so the release bills THIS session)
+        # the request context, so the release bills THIS session).
+        # Disarm the cancel flag FIRST: the release path crosses the
+        # same barrier sites and must never itself be cancelled
+        req.disarm_cancel()
         with page_account_scope(acct), obs_context.use(req):
             try:
                 cur = script.obj
@@ -277,8 +372,12 @@ def run_session(server, sess: Session) -> dict:
     wall = time.perf_counter() - t0
 
     sess.wall_s = round(wall, 4)
+    if cancelled:
+        status = CANCELLED
+        error = f"cancelled ({cancelled})"
+    else:
+        status = FAILED if error else DONE
     sess.error = error
-    status = FAILED if error else DONE
     # the meta deltas come from the session's OWN RequestAccount — fed
     # from the same funnels as the process-global counters, scoped to
     # this request's context — so they are exact with any number of
@@ -300,6 +399,9 @@ def run_session(server, sess: Session) -> dict:
             "resumed": sess.resumed,
             "resharded": sess.resharded,
             "failed_over": sess.failed_over,
+            "cancel_reason": cancelled,
+            "deadline_ms": sess.deadline_ms,
+            "mesh_width": sess.mesh_width,
             "dispatches": profile["dispatches"],
             "plan_cache": plan_delta,
             "pages": acct.snapshot(),
@@ -310,6 +412,16 @@ def run_session(server, sess: Session) -> dict:
     # at 50 ms must never observe state=done while the result file is
     # still unwritten (it would read a bogus "result file unavailable"
     # final record)
-    atomic_write_json(server.result_path(sess.sid), result)
+    try:
+        atomic_write_json(server.result_path(sess.sid), result)
+    except OSError as e:
+        # the MOST likely ENOSPC site (inode/quota exhaustion passes
+        # the free-byte probe): latch the pressure monitor so the
+        # daemon degrades instead of admitting more work that fails
+        # at this exact line, then let the worker's belt record FAILED
+        disk = getattr(server, "disk", None)
+        if disk is not None:
+            disk.note_error(e)
+        raise
     sess.state = status
     return result

@@ -116,6 +116,19 @@ run_serve_subset_full() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_overload_subset_quick() {
+  echo "== overload subset (fast): auth, shed, deadline, watchdog, pressure, autoscaler =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \
+      -k 'auth or shed or deadline or stall or disk or autoscaler or retry_after or healthz' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_overload_subset_full() {
+  echo "== overload subset (full): cancel races, kill -9 cancelled replay, fleet no-resurrect =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_overload.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_fleet_subset_quick() {
   echo "== fleet subset (fast): lease/claim/ring units + router + satellites =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
@@ -154,6 +167,7 @@ if [ "${1:-}" = "quick" ]; then
   run_exec_subset
   run_ft_subset
   run_serve_subset_quick
+  run_overload_subset_quick
   run_fleet_subset_quick
   run_context_subset
   run_elastic_subset_quick
@@ -179,6 +193,7 @@ run_metrics_subset
 run_exec_subset
 run_ft_subset
 run_serve_subset_full
+run_overload_subset_full
 run_fleet_subset_full
 run_context_subset
 run_elastic_subset_full

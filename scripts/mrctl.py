@@ -1,10 +1,13 @@
 #!/usr/bin/env python
 """mrctl — operator client for the serve/ daemon (doc/serve.md).
 
-    mrctl.py [--port N | --state DIR] submit FILE [--tenant T] [--wait]
+    mrctl.py [--port N | --state DIR] [--token TOK] submit FILE
+             [--tenant T] [--wait] [--deadline-ms N] [--priority P]
+             [--retry-wait SECS]
     mrctl.py [...] submit - --tenant T          # script from stdin
     mrctl.py [...] status [SID]                 # one session / all
     mrctl.py [...] result SID [--wait SECS]
+    mrctl.py [...] cancel SID                   # DELETE /v1/jobs/<sid>
     mrctl.py [...] profile SID                  # per-request cost profile
     mrctl.py [...] watch SID [--timeout SECS]   # stream /events (no poll)
     mrctl.py [...] slo
@@ -20,10 +23,12 @@ then any live replica, and a refused connection retries with backoff
 (``--retries``, ft/retry semantics) re-running discovery between
 attempts — a client pointed at a dead replica finds the fleet instead
 of exiting 3.  Router replica redirects (307) are followed.
+``--token`` (or ``MRTPU_SERVE_TOKEN``) rides as the bearer token when
+the daemon has ``MRTPU_SERVE_TOKENS`` armed.
 Exit codes: 0 ok, 2 usage, 3 daemon unreachable, 4 rejected (429/503 —
 stderr carries Retry-After), 5 session failed, 6 still running at the
 --wait/--timeout deadline (`watch` included: a stream that ends before
-the terminal status exits 6).
+the terminal status exits 6), 7 session cancelled.
 """
 
 from __future__ import annotations
@@ -40,19 +45,30 @@ if _REPO not in sys.path:
 
 def _client(args):
     from gpu_mapreduce_tpu.serve.client import ServeClient
+    token = args.token or None   # None → ServeClient falls back to
+    #                              MRTPU_SERVE_TOKEN from the env
     if args.port is not None:
-        return ServeClient.local(args.port, retries=args.retries)
+        return ServeClient.local(args.port, retries=args.retries,
+                                 token=token)
     state = args.state or os.environ.get("MRTPU_SERVE_STATE")
     if not state:
         print("need --port or --state (or MRTPU_SERVE_STATE)",
               file=sys.stderr)
         sys.exit(2)
     try:
-        return ServeClient.from_state_dir(state, retries=args.retries)
+        return ServeClient.from_state_dir(state, retries=args.retries,
+                                          token=token)
     except (OSError, ValueError) as e:
         print(f"cannot discover daemon from {state!r}: {e}",
               file=sys.stderr)
         sys.exit(3)
+
+
+def _terminal_code(r: dict) -> int:
+    """0 done, 5 failed, 7 cancelled — one mapping for every verb that
+    reports a terminal session."""
+    status = r.get("status") or r.get("state")
+    return {"failed": 5, "cancelled": 7}.get(status, 0)
 
 
 def main(argv=None) -> int:
@@ -63,10 +79,26 @@ def main(argv=None) -> int:
     p.add_argument("--retries", type=int, default=3,
                    help="connection-refused retries (backoff + fleet "
                         "re-discovery between attempts; 0 = one shot)")
+    p.add_argument("--token", default=None,
+                   help="bearer token for a MRTPU_SERVE_TOKENS-armed "
+                        "daemon (default MRTPU_SERVE_TOKEN)")
     sub = p.add_subparsers(dest="cmd", required=True)
     sp = sub.add_parser("submit")
     sp.add_argument("file", help="OINK script path, or - for stdin")
-    sp.add_argument("--tenant", default="default")
+    sp.add_argument("--tenant", default=None,
+                    help="tenant the job bills to (default: the "
+                         "token's tenant on an auth-armed daemon, "
+                         "else 'default')")
+    sp.add_argument("--deadline-ms", type=int, default=None,
+                    help="execution deadline: the session cancels at "
+                         "its next op barrier past this budget")
+    sp.add_argument("--priority", type=int, default=None,
+                    help="admission priority (higher first, ±9)")
+    sp.add_argument("--retry-wait", type=float, default=0.0,
+                    metavar="SECS",
+                    help="honor 429 Retry-After by waiting up to this "
+                         "total budget before giving up (0 = fail "
+                         "fast)")
     sp.add_argument("--wait", action="store_true",
                     help="block until the session finishes; print the "
                          "result record")
@@ -80,6 +112,8 @@ def main(argv=None) -> int:
     rs = sub.add_parser("result")
     rs.add_argument("sid")
     rs.add_argument("--wait", type=float, default=0.0, metavar="SECS")
+    cn = sub.add_parser("cancel")
+    cn.add_argument("sid")
     pf = sub.add_parser("profile")
     pf.add_argument("sid")
     wt = sub.add_parser("watch")
@@ -100,11 +134,14 @@ def main(argv=None) -> int:
         if args.cmd == "submit":
             text = sys.stdin.read() if args.file == "-" else \
                 open(args.file).read()
-            r = c.submit(script=text, tenant=args.tenant)
+            r = c.submit(script=text, tenant=args.tenant,
+                         deadline_ms=args.deadline_ms,
+                         priority=args.priority,
+                         retry_after_wait=args.retry_wait)
             if args.wait:
                 r = c.wait(r["id"], timeout=args.timeout)
                 print(json.dumps(r, indent=2))
-                return 5 if r.get("status") == "failed" else 0
+                return _terminal_code(r)
             print(json.dumps(r))
         elif args.cmd == "status":
             out = c.status(args.sid) if args.sid else c.jobs()
@@ -113,7 +150,9 @@ def main(argv=None) -> int:
             r = c.wait(args.sid, timeout=args.wait) if args.wait \
                 else c.result(args.sid)
             print(json.dumps(r, indent=2))
-            return 5 if r.get("status") == "failed" else 0
+            return _terminal_code(r)
+        elif args.cmd == "cancel":
+            print(json.dumps(c.cancel(args.sid)))
         elif args.cmd == "profile":
             r = c.profile(args.sid)
             print(json.dumps(r, indent=2))
@@ -128,6 +167,8 @@ def main(argv=None) -> int:
             # checked on heartbeats and reconnects, so a terminal
             # status arriving late is reported, not discarded)
             import time as _time
+
+            from gpu_mapreduce_tpu.serve.session import TERMINAL
             deadline = _time.monotonic() + args.timeout
             last_state = None
             expired = False
@@ -148,8 +189,8 @@ def main(argv=None) -> int:
                         return 3
                     if kind == "status":
                         last_state = ev.get("state")
-                        if last_state in ("done", "failed"):
-                            return 5 if last_state == "failed" else 0
+                        if last_state in TERMINAL:
+                            return _terminal_code(ev)
                 else:
                     # server-side stream cap without a terminal status:
                     # reconnect unless the operator's deadline passed
@@ -172,6 +213,8 @@ def main(argv=None) -> int:
             print(f"Retry-After: {e.retry_after}s", file=sys.stderr)
         if e.code in (429, 503):
             return 4
+        if e.code == 409:
+            return 0     # cancel of a terminal session: no-op by design
         return 6 if e.code == 408 else 3    # 408 = still running at
         #                                     the --wait deadline
     except OSError as e:

@@ -748,6 +748,138 @@ def main():
             # even when a client thread failed the workload
             obs_slo.reset()
 
+    def do_overload():
+        # self-protection row (serve/overload.py, doc/serve.md#slo-
+        # burn-shedding): ONE greedy tenant burns its SLO error budget
+        # with expensive failing requests while polite tenants run
+        # normal work.  The daemon must shed the GREEDY tenant (429 +
+        # honest Retry-After) and keep the polite tenants' p99 inside
+        # the soak bound — overload protection that picks the right
+        # victim, asserted then published.
+        import tempfile
+        import threading
+
+        from gpu_mapreduce_tpu.obs import slo as obs_slo
+        from gpu_mapreduce_tpu.serve import Server, ServeClient, ServeError
+        npolite = env_knob("SOAK_OVERLOAD_POLITE", int, 3)
+        nreqs = env_knob("SOAK_OVERLOAD_REQS", int, 6)
+        p99_bound_ms = env_knob("SOAK_OVERLOAD_P99_MS", float, 30000.0)
+        eng = obs_slo.configure(obs_slo.parse_slo(
+            "tenant=*;err_pct=5;windows=60,300"))
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                rng6 = np.random.default_rng(41)
+                big = os.path.join(tmp, "big.txt")
+                with open(big, "w") as f:
+                    for w in rng6.integers(0, 2048, 40000):
+                        f.write(f"w{w:04d} ")
+                small = os.path.join(tmp, "small.txt")
+                with open(small, "w") as f:
+                    for w in rng6.integers(0, 256, 4000):
+                        f.write(f"w{w:03d} ")
+                # expensive AND failing: real shuffle work, then a bad
+                # command — the burn engine sees failures, the cost
+                # profiles see an expensive tenant
+                greedy_script = (f"variable files index {big}\n"
+                                 f"wordfreq 5 -i v_files\n"
+                                 f"frobnicate\n")
+                polite_script = (f"variable files index {small}\n"
+                                 f"wordfreq 5 -i v_files\n")
+                srv = Server(port=0, workers=2, queue_cap=16,
+                             state_dir=os.path.join(tmp, "state"))
+                port = srv.start()
+                try:
+                    seed_c = ServeClient.local(port)
+                    # phase 1 — the greedy tenant builds its own case:
+                    # failed sessions feed the burn engine, their cost
+                    # feeds the shed ranking.  The shedder can trip
+                    # MID-SEED (admission re-evaluates the burn within
+                    # ~1 s of the failures) — an early 429 IS the
+                    # feature engaging, not a seed failure
+                    for _ in range(4):
+                        try:
+                            r = seed_c.submit(script=greedy_script,
+                                              tenant="greedy")
+                        except ServeError as e:
+                            if e.code == 429:
+                                break       # already shedding
+                            raise
+                        seed_c.wait(r["id"], timeout=300)
+                    eng.tick(force=True)
+                    assert eng.burning("greedy"), \
+                        "greedy tenant never started burning"
+                    # phase 2 — contention: greedy hammers, polite works
+                    shed = [0]
+                    polite_lat: list = []
+                    client_errors: list = []
+                    lock = threading.Lock()
+                    stop = threading.Event()
+
+                    def greedy_client():
+                        c = ServeClient.local(port)
+                        while not stop.is_set():
+                            try:
+                                r = c.submit(script=greedy_script,
+                                             tenant="greedy")
+                                c.wait(r["id"], timeout=300)
+                            except ServeError as e:
+                                if e.code != 429:
+                                    with lock:
+                                        client_errors.append(
+                                            f"greedy: {e!r}")
+                                    return
+                                with lock:
+                                    shed[0] += 1
+                                stop.wait(min(2.0, e.retry_after or 1))
+
+                    def polite_client(ci):
+                        try:
+                            c = ServeClient.local(port)
+                            for _ in range(nreqs):
+                                t0 = time.perf_counter()
+                                r = c.submit(script=polite_script,
+                                             tenant=f"polite{ci}",
+                                             retry_after_wait=60.0)
+                                res = c.wait(r["id"], timeout=300)
+                                if res.get("status") != "done":
+                                    raise RuntimeError(res.get("error"))
+                                with lock:
+                                    polite_lat.append(
+                                        time.perf_counter() - t0)
+                        except Exception as e:  # noqa: BLE001
+                            with lock:
+                                client_errors.append(
+                                    f"polite{ci}: {e!r}")
+
+                    g = threading.Thread(target=greedy_client)
+                    polite = [threading.Thread(target=polite_client,
+                                               args=(ci,))
+                              for ci in range(npolite)]
+                    g.start()
+                    for t in polite:
+                        t.start()
+                    for t in polite:
+                        t.join()
+                    stop.set()
+                    g.join(timeout=310)
+                finally:
+                    srv.shutdown()
+                if client_errors:
+                    raise RuntimeError("; ".join(client_errors[:3]))
+                assert shed[0] > 0, \
+                    "greedy tenant was never shed under overload"
+                p99_ms = float(np.percentile(polite_lat, 99)) * 1000.0
+                assert p99_ms <= p99_bound_ms, \
+                    f"polite p99 {p99_ms:.0f}ms blew the " \
+                    f"{p99_bound_ms:.0f}ms bound while greedy was shed"
+                published["overload_shed_total"] = shed[0]
+                published["overload_polite_p99_ms"] = round(p99_ms, 1)
+                print(f"overload: greedy shed {shed[0]}x while "
+                      f"{npolite} polite tenants x {nreqs} reqs held "
+                      f"p99 {p99_ms:.0f}ms (bound {p99_bound_ms:.0f}ms)")
+        finally:
+            obs_slo.reset()
+
     def do_fleet():
         # serve-fleet row (serve/fleet.py + serve/router.py): N
         # subprocess replicas behind the consistent-hash router; one
@@ -874,7 +1006,8 @@ def main():
                  ("group_heavy", do_group_heavy),
                  ("pagerank", do_pagerank),
                  ("pagerank_northstar", do_pagerank_northstar),
-                 ("serve", do_serve), ("fleet", do_fleet)]
+                 ("serve", do_serve), ("overload", do_overload),
+                 ("fleet", do_fleet)]
     if chaos_seed is not None:
         workloads.append(("chaos", do_chaos))
     serve_only = "serve" in sys.argv[1:]
@@ -884,6 +1017,11 @@ def main():
     if "fleet" in sys.argv[1:]:
         # `soak.py fleet`: ONLY the replicated-daemon failover soak
         workloads = [("fleet", do_fleet)]
+        serve_only = True       # partial publish: merge, don't erase
+    if "overload" in sys.argv[1:]:
+        # `soak.py overload`: ONLY the shed-the-greedy-tenant soak
+        # (doc/serve.md#slo-burn-shedding)
+        workloads = [("overload", do_overload)]
         serve_only = True       # partial publish: merge, don't erase
     for i, (name, fn) in enumerate(workloads, 1):
         guard(name, fn)

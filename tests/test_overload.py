@@ -1,0 +1,744 @@
+"""PR 14 — the self-protecting serve plane (doc/serve.md).
+
+Tenant bearer-token auth (401/403 before any journal write), SLO-burn
+shedding with per-tenant cost evidence, request deadlines + cooperative
+cancellation at op barriers (DELETE /v1/jobs/<id>), the hung-session
+watchdog, resource-pressure degradation, and the mesh autoscaler —
+plus the cancel-vs-complete race and kill -9 / fleet-takeover
+no-resurrection goldens the issue's acceptance criteria name.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gpu_mapreduce_tpu.core.runtime import CancelledError
+from gpu_mapreduce_tpu.serve import Server, ServeClient, ServeError
+from gpu_mapreduce_tpu.serve.auth import TokenAuth
+from gpu_mapreduce_tpu.serve.overload import (CostProfiles, DiskMonitor,
+                                              SHED_PRIORITY)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_corpus(path, words, repeat):
+    path.write_text((" ".join(words) + " ") * repeat)
+    return str(path)
+
+
+def wf_script(corpus, top=3, out=None, lines_extra=()):
+    lines = [f"variable files index {corpus}",
+             f"wordfreq {top} -i v_files" +
+             (f" -o {out} wf" if out else "")]
+    lines.extend(lines_extra)
+    return "\n".join(lines) + "\n"
+
+
+def slow_script(corpus, ncmds=300):
+    """Many cheap commands: a session that runs for seconds but crosses
+    a command barrier every few milliseconds — the deterministic canvas
+    for mid-run cancellation."""
+    return f"variable files index {corpus}\n" + \
+        "wordfreq 3 -i v_files\n" * ncmds
+
+
+def wait_state(client, sid, state, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if client.status(sid)["state"] == state:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"{sid} never reached {state!r}")
+
+
+# ---------------------------------------------------------------------------
+# tenant auth (serve/auth.py)
+# ---------------------------------------------------------------------------
+
+def test_token_auth_parse_and_gate(tmp_path):
+    # inline spec
+    a = TokenAuth("acme=t1, beta=t2,*=root")
+    assert a.armed
+    hdr = lambda t: {"Authorization": f"Bearer {t}"}  # noqa: E731
+    assert a.identify(hdr("t1")) == "acme"
+    assert a.identify(hdr("root")) == "*"
+    assert a.identify(hdr("nope")) is None
+    assert a.identify({}) is None
+    assert a.gate(hdr("t1"), tenant="acme") == (0, None)
+    assert a.gate(hdr("t1"), tenant="beta")[0] == 403
+    assert a.gate(hdr("t1"), admin=True)[0] == 403
+    assert a.gate(hdr("root"), tenant="beta") == (0, None)
+    assert a.gate(hdr("root"), admin=True) == (0, None)
+    assert a.gate({}, tenant="acme")[0] == 401
+    # file form (with a malformed line that must grant nothing)
+    f = tmp_path / "tokens"
+    f.write_text("# comment\nacme=ft1\nbroken-line\nbeta=ft2\n")
+    b = TokenAuth(str(f))
+    assert b.identify(hdr("ft1")) == "acme"
+    assert b.identify(hdr("broken-line")) is None
+    # disarmed: everything passes
+    c = TokenAuth("")
+    assert not c.armed
+    assert c.gate({}, tenant="x", admin=True) == (0, None)
+
+
+def test_auth_rejects_before_any_journal_write(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    monkeypatch.setenv("MRTPU_SERVE_TOKENS", "acme=tok-a,*=tok-admin")
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 20)
+        anon = ServeClient.local(srv.port)
+        acme = ServeClient.local(srv.port, token="tok-a")
+        admin = ServeClient.local(srv.port, token="tok-admin")
+        # no token → 401; wrong tenant → 403; neither touches the journal
+        with pytest.raises(ServeError) as ei:
+            anon.submit(script=wf_script(corpus))
+        assert ei.value.code == 401
+        with pytest.raises(ServeError) as ei:
+            acme.submit(script=wf_script(corpus), tenant="beta")
+        assert ei.value.code == 403
+        assert [r for r in read_journal(srv.state_dir)
+                if r.get("kind") == "serve_submit"] == []
+        # the token names the tenant when the body omits it
+        r = acme.submit(script=wf_script(corpus))
+        assert r["tenant"] == "acme"
+        assert acme.wait(r["id"])["status"] == "done"
+        # tenant tokens read only their own sessions; admin reads all
+        with pytest.raises(ServeError) as ei:
+            ServeClient.local(srv.port, token="tok-admin").cancel(r["id"])
+        assert ei.value.code == 409      # admin CAN act (terminal→409)
+        beta_view = admin.jobs()
+        assert any(j["id"] == r["id"] for j in beta_view)
+        # operator verbs need the admin token
+        with pytest.raises(ServeError) as ei:
+            acme.drain()
+        assert ei.value.code == 403
+        assert admin.drain() == {"draining": True}
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# deadlines + cooperative cancellation
+# ---------------------------------------------------------------------------
+
+def test_deadline_cancels_at_next_barrier(tmp_path):
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b", "c"], 50)
+        r = c.submit(script=wf_script(corpus), deadline_ms=1)
+        assert r["deadline_ms"] == 1
+        out = c.wait(r["id"])
+        assert out["status"] == "cancelled"
+        assert out["meta"]["cancel_reason"] == "deadline"
+        assert "deadline" in out["error"]
+        # under fuse=1 the cancel may trip with DEFERRED stages
+        # recorded — the release path must discard, never dispatch,
+        # them (and the daemon must survive to run the next session)
+        fused = "set fuse 1\n" + wf_script(corpus)
+        r2 = c.submit(script=fused, deadline_ms=1)
+        assert c.wait(r2["id"])["status"] == "cancelled"
+        r3 = c.submit(script=wf_script(corpus))
+        assert c.wait(r3["id"])["status"] == "done"
+        # bad deadlines are a 400, not an accepted lie
+        for bad in (0, -5, "soon"):
+            with pytest.raises(ServeError) as ei:
+                c._req("POST", "/v1/jobs",
+                       {"script": wf_script(corpus),
+                        "deadline_ms": bad})
+            assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+
+
+def test_delete_midrun_stops_releases_pages_resumable(tmp_path):
+    """The acceptance golden's cancel half: a DELETE mid-run stops at
+    the next barrier, releases the tenant's pages, journals a
+    ``cancelled`` terminal record, and leaves the session dir
+    resumable (journal with begin + checkpoints intact)."""
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b", "c"], 20000)
+        r = c.submit(script=slow_script(corpus), tenant="acme")
+        wait_state(c, r["id"], "running")
+        time.sleep(0.8)            # let a few commands (and a ckpt) land
+        resp = c.cancel(r["id"])
+        assert resp["state"] in ("cancelling", "cancelled")
+        out = c.wait(r["id"], timeout=60)
+        assert out["status"] == "cancelled"
+        assert out["meta"]["cancel_reason"] == "client"
+        # pages released: the tenant gauge deflated to zero
+        pages = srv.budgets.snapshot()["acme"]
+        assert pages["pages_in_use"] == 0
+        # terminal record journaled
+        done = [x for x in read_journal(srv.state_dir)
+                if x.get("kind") == "serve_done" and
+                x.get("sid") == r["id"]]
+        assert done and done[-1]["status"] == "cancelled"
+        # session dir still resumable: begin (+ checkpoint) intact
+        kinds = {x.get("kind")
+                 for x in read_journal(srv.session_dir(r["id"]))}
+        assert "begin" in kinds
+        # a second cancel is a no-op 409
+        with pytest.raises(ServeError) as ei:
+            c.cancel(r["id"])
+        assert ei.value.code == 409
+    finally:
+        srv.shutdown()
+
+
+def test_recover_finalizes_acknowledged_midrun_cancel(tmp_path):
+    """A ``serve_cancel`` record with no terminal record (kill -9
+    between the cancel's 202 and the session's next barrier): the
+    restarted daemon finalizes the session as ``cancelled`` instead of
+    resurrecting and running it to completion."""
+    from gpu_mapreduce_tpu.ft.journal import Journal, read_journal
+    state = str(tmp_path / "state")
+    j = Journal(state, script_mode=True)
+    j.append({"kind": "serve_submit", "sid": "s000001",
+              "tenant": "acme", "fmt": "oink", "payload": "mr x\n",
+              "seq": 1, "priority": 0, "utc": "", "trace": "aaaa"})
+    j.append({"kind": "serve_cancel", "sid": "s000001",
+              "reason": "client", "trace": "aaaa"})
+    j.close()
+    srv = Server(port=0, workers=2, state_dir=state)
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        assert c.status("s000001")["state"] == "cancelled"
+        out = c.result("s000001")
+        assert out["status"] == "cancelled"
+        assert out["output"] == ""             # never executed
+        done = [r for r in read_journal(state)
+                if r.get("kind") == "serve_done"]
+        assert done and done[-1]["status"] == "cancelled"
+    finally:
+        srv.shutdown()
+
+
+def test_router_store_fallback_enforces_auth(tmp_path, monkeypatch):
+    """The shared-result-store fallback (owner dead, no replica in the
+    loop) must make the same auth decision a replica would — a dead
+    owner is not an auth bypass."""
+    from gpu_mapreduce_tpu.serve.router import Router
+    monkeypatch.setenv("MRTPU_SERVE_TOKENS", "acme=ta,beta=tb")
+    root = tmp_path / "fleet"
+    os.makedirs(root / "results", exist_ok=True)
+    sid = "ra.s000001"
+    with open(root / "results" / (sid + ".json"), "w") as f:
+        json.dump({"id": sid, "tenant": "acme", "status": "done",
+                   "output": "secret", "files": {}, "mrs": {},
+                   "meta": {}}, f)
+    rt = Router(str(root))           # no listener needed: drive _handle
+    path = f"/v1/jobs/{sid}/result"
+    code, *_ = rt._handle("GET", path, b"", {})
+    assert code == 401
+    # a VALID foreign token reads 404, not 403 — sequential sids must
+    # not become an existence oracle over other tenants' sessions
+    code, *_ = rt._handle("GET", path, b"",
+                          {"Authorization": "Bearer tb"})
+    assert code == 404
+    code, body, *_ = rt._handle("GET", path, b"",
+                                {"Authorization": "Bearer ta"})
+    assert code == 200 and body["output"] == "secret"
+    # the cancel fallback's 409 is scoped the same way
+    code, *_ = rt._handle("DELETE", f"/v1/jobs/{sid}", b"", {})
+    assert code == 401
+    code, *_ = rt._handle("DELETE", f"/v1/jobs/{sid}", b"",
+                          {"Authorization": "Bearer ta"})
+    assert code == 409
+
+
+def test_cancel_queued_session_never_runs(tmp_path):
+    srv = Server(port=0, workers=0, paused=True,
+                 state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 20)
+        r = c.submit(script=wf_script(corpus))
+        resp = c.cancel(r["id"])
+        assert resp["state"] == "cancelled"
+        out = c.result(r["id"])
+        assert out["status"] == "cancelled"
+        assert out["output"] == ""           # it never executed
+        assert out["meta"]["ran"] is False
+    finally:
+        srv.shutdown()
+
+
+def test_cancel_vs_complete_race_409_never_corrupts(tmp_path):
+    """Concurrent cancel-vs-complete: whatever wins, the result file is
+    coherent, matches the listed state, and a cancel that lost the race
+    is a 409 that leaves the result byte-identical."""
+    import hashlib
+    srv = Server(port=0, workers=2, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 30)
+        for i in range(6):
+            r = c.submit(script=wf_script(corpus))
+            try:
+                c.cancel(r["id"])
+            except ServeError as e:
+                assert e.code == 409         # finished first: no-op
+            out = c.wait(r["id"], timeout=60)
+            status = out["status"]
+            assert status in ("done", "cancelled")
+            # result file coherent + stable under a late cancel
+            path = srv.result_path(r["id"])
+            with open(path, "rb") as f:
+                before = hashlib.sha256(f.read()).hexdigest()
+            with pytest.raises(ServeError) as ei:
+                c.cancel(r["id"])
+            assert ei.value.code == 409
+            with open(path, "rb") as f:
+                assert hashlib.sha256(f.read()).hexdigest() == before
+            assert json.load(open(path))["status"] == status
+            assert c.status(r["id"])["state"] == status
+    finally:
+        srv.shutdown()
+
+
+def _spawn_daemon(state, extra):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    p = subprocess.Popen(
+        [sys.executable, "-m", "gpu_mapreduce_tpu.serve",
+         "--port", "0", "--state", state] + extra,
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL)
+    line = json.loads(p.stdout.readline())
+    return p, int(line["serving"])
+
+
+def test_kill9_replay_keeps_cancelled_terminal(tmp_path):
+    """kill -9 after a journaled ``cancelled`` record: the restarted
+    daemon replays the OTHER accepted sessions but must NOT resurrect
+    the cancelled one."""
+    corpora = [write_corpus(tmp_path / f"c{i}.txt", ["x", f"w{i}"], 30)
+               for i in range(3)]
+    scripts = [wf_script(c, out=f"tmp.wf{i}")
+               for i, c in enumerate(corpora)]
+    state = str(tmp_path / "state")
+    p, port = _spawn_daemon(state, ["--paused"])
+    try:
+        c = ServeClient.local(port)
+        sids = [c.submit(script=s)["id"] for s in scripts]
+        assert c.cancel(sids[1])["state"] == "cancelled"
+    finally:
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait()
+    p2, port2 = _spawn_daemon(state, ["--workers", "2"])
+    try:
+        c2 = ServeClient.local(port2)
+        for sid in (sids[0], sids[2]):
+            assert c2.wait(sid, timeout=120)["status"] == "done"
+        out = c2.result(sids[1])
+        assert out["status"] == "cancelled"
+        assert out["output"] == ""           # never executed, ever
+        assert c2.status(sids[1])["state"] == "cancelled"
+        c2.shutdown()
+        p2.wait(timeout=30)
+    finally:
+        if p2.poll() is None:
+            p2.kill()
+            p2.wait()
+
+
+def test_fleet_takeover_skips_cancelled_session(tmp_path):
+    """A dead replica's journal holds submit(s1), submit(s2),
+    done(s1, cancelled): the survivor adopts and finishes s2 but never
+    resurrects s1 — the fleet half of the no-resurrection contract."""
+    root = tmp_path / "fleet"
+
+    def replica(rid, **kw):
+        return Server(port=0, queue_cap=8, fleet_dir=str(root),
+                      replica_id=rid, lease_s=0.6, heartbeat_s=0.1,
+                      **kw)
+
+    corpus = write_corpus(tmp_path / "w.txt", ["p", "q"], 40)
+    a = replica("ra", workers=0, paused=True)
+    a.start()
+    ca = ServeClient.local(a.port)
+    s1 = ca.submit(script=wf_script(corpus))["id"]
+    s2 = ca.submit(script=wf_script(corpus, out="tmp.wf"))["id"]
+    assert ca.cancel(s1)["state"] == "cancelled"
+    # kill -9 equivalent for an embedded replica: heartbeat stalls,
+    # listener dies, lease left behind (test_fleet.py's idiom)
+    a._fleet_suspended = True
+    if a._listener is not None:
+        a._listener.stop()
+    b = replica("rb", workers=2)
+    b.start()
+    try:
+        deadline = time.monotonic() + 30
+        res_path = os.path.join(str(root), "results", s2 + ".json")
+        while time.monotonic() < deadline:
+            try:
+                if json.load(open(res_path))["status"] == "done":
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.05)
+        out2 = json.load(open(res_path))
+        assert out2["status"] == "done"
+        assert out2["meta"]["failed_over"] is True
+        # s1 was never adopted and its stored result stays cancelled
+        with b._lock:
+            assert s1 not in b.sessions
+        res1 = json.load(open(os.path.join(str(root), "results",
+                                           s1 + ".json")))
+        assert res1["status"] == "cancelled"
+    finally:
+        b.shutdown()
+        a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_greedy_tenant_polite_unaffected(tmp_path):
+    """A tenant burning in every window is shed (expensive profile) or
+    deprioritized (cheap profile) BEFORE the shared queue rejects
+    anyone; polite tenants admit normally; the rising edge lands in the
+    journal exactly once; every shed bumps the metric."""
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    from gpu_mapreduce_tpu.obs import slo as obs_slo
+    from gpu_mapreduce_tpu.obs.metrics import get_registry
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 20)
+        obs_slo.configure(obs_slo.parse_slo(
+            "tenant=*;err_pct=1;windows=60,600"))
+        # synthetic burn evidence: greedy fails half its sessions
+        reg = get_registry()
+        ctr = reg.counter("mrtpu_serve_sessions_total",
+                          "finished sessions by tenant and status",
+                          ("tenant", "status"))
+        for _ in range(5):
+            ctr.inc(tenant="greedy", status="failed")
+            ctr.inc(tenant="greedy", status="done")
+        eng = obs_slo.get_engine()
+        eng.tick(force=True)
+        assert eng.burning("greedy")
+        # cost evidence: greedy's sessions are the expensive ones
+        srv.profiles.record("polite", 0.05, 1000.0)
+        srv.profiles.record("greedy", 10.0, 1e6)
+        # greedy sheds — 429 with an honest Retry-After
+        for _ in range(3):
+            with pytest.raises(ServeError) as ei:
+                c.submit(script=wf_script(corpus), tenant="greedy")
+            assert ei.value.code == 429
+            assert ei.value.retry_after >= 1
+        # ... while polite admits fine, even repeatedly
+        r = c.submit(script=wf_script(corpus), tenant="polite")
+        assert c.wait(r["id"])["status"] == "done"
+        # rising edge journaled ONCE for the three sheds
+        sheds = [x for x in read_journal(srv.state_dir)
+                 if x.get("kind") == "serve_shed"]
+        assert [(s["tenant"], s["reason"]) for s in sheds] == \
+            [("greedy", "slo_burn")]
+        # every shed metered
+        samples = reg.collect()["mrtpu_serve_shed_total"]["samples"]
+        greedy = [s for s in samples
+                  if s["labels"] == {"tenant": "greedy",
+                                     "reason": "slo_burn"}]
+        assert greedy and greedy[0]["value"] == 3
+        # a burning-but-CHEAP tenant is deprioritized, not shed
+        for _ in range(4):
+            ctr.inc(tenant="cheap", status="failed")
+        eng.tick(force=True)
+        assert eng.burning("cheap")
+        srv.profiles.record("cheap", 0.01, 100.0)
+        r2 = c.submit(script=wf_script(corpus), tenant="cheap")
+        assert c.status(r2["id"])["priority"] == SHED_PRIORITY
+        assert c.wait(r2["id"])["status"] == "done"
+    finally:
+        obs_slo.reset()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# resource-pressure degradation
+# ---------------------------------------------------------------------------
+
+def test_disk_monitor_enospc_latch_and_recovery(tmp_path):
+    import errno
+    m = DiskMonitor([str(tmp_path)], floor_mb=0)    # probing off
+    assert m.check() is None
+    assert m.note_error(RuntimeError("wrapped")) is False
+    chained = RuntimeError("session failed")
+    chained.__cause__ = OSError(errno.ENOSPC, "No space left on device")
+    assert m.note_error(chained) is True
+    assert m.check() is not None                    # latched
+    m._last_enospc = 0.0                            # hold expires
+    m._last_probe = 0.0
+    assert m.check() is None                        # self-healed
+
+
+def test_disk_pressure_sheds_new_admissions(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRTPU_SERVE_DISK_MIN", str(10 ** 9))  # ~1 PB
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 20)
+        # /healthz reports degraded (503) — LBs and the router reroute
+        assert srv._health_status() == "degraded"
+        assert c.healthz() is False
+        with pytest.raises(ServeError) as ei:
+            c.submit(script=wf_script(corpus))
+        assert ei.value.code == 503
+        assert ei.value.retry_after is not None
+        assert "degraded" in ei.value.body["error"]
+        # pressure clears → daemon admits again, no restart
+        srv.disk.floor_mb = 0
+        srv.disk._last_probe = 0.0
+        assert srv._health_status() == "ok"
+        r = c.submit(script=wf_script(corpus))
+        assert c.wait(r["id"])["status"] == "done"
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hung-session watchdog
+# ---------------------------------------------------------------------------
+
+def test_stall_watchdog_flags_and_cancels(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.obs.context import RequestAccount
+    from gpu_mapreduce_tpu.serve.session import RUNNING, Session
+    monkeypatch.setenv("MRTPU_SERVE_STALL", "0.5")
+    monkeypatch.setenv("MRTPU_SERVE_STALL_CANCEL", "1")
+    srv = Server(port=0, workers=0, paused=True,
+                 state_dir=str(tmp_path / "state"))
+    assert srv.stall_s == 0.5 and srv.stall_cancel
+    # a synthetic RUNNING session whose account made no barrier
+    # progress for > stall_s
+    sess = Session(sid="sX", tenant="acme", payload="")
+    sess.account = RequestAccount(tenant="acme")
+    sess.state = RUNNING
+    with srv._lock:
+        srv.sessions["sX"] = sess
+    sess.account.last_barrier = time.monotonic() - 10.0
+    srv._stall_scan(time.monotonic())
+    assert sess.stalled is True
+    assert srv.stall_count == 1
+    assert sess.account.cancel_reason == "stall"
+    with pytest.raises(CancelledError):
+        sess.account.check_cancel()
+    # progress resumes → the flag clears (a slow op is not a hang);
+    # the cancel already armed stays armed — cancel() keeps the first
+    # reason by design
+    sess.account.last_barrier = time.monotonic()
+    srv._stall_scan(time.monotonic())
+    assert sess.stalled is False
+    assert srv.stall_count == 1           # no re-flag churn
+
+
+def test_stall_watchdog_quiet_on_progress(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRTPU_SERVE_STALL", "30")
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 200)
+        r = c.submit(script=wf_script(corpus))
+        out = c.wait(r["id"])
+        assert out["status"] == "done"
+        assert srv.stall_count == 0
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# mesh autoscaler
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_width_from_profiled_volume():
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.serve.autoscale import MeshAutoscaler
+    prof = CostProfiles()
+    a = MeshAutoscaler(make_mesh(4), prof, enabled=True)
+    assert a.full_width == 4
+    # no evidence → full width (never narrow on a guess)
+    assert a.width_for("unknown") == 4
+    prof.record("tiny", 0.05, 100.0)            # ~0 exchange
+    assert a.width_for("tiny") == 1
+    prof.record("mid", 0.5, 6 << 20)            # ~6 MiB → 2 shards
+    assert a.width_for("mid") == 2
+    prof.record("heavy", 5.0, 1 << 30)          # 1 GiB → full
+    assert a.width_for("heavy") == 4
+    # sub-meshes cache and stay inside the full mesh's device prefix
+    m1 = a.mesh_for(1)
+    assert m1 is a.mesh_for(1)
+    assert a.mesh_for(4) is a.full
+    # serial backend / width-1 mesh: autoscaler disarms itself
+    assert MeshAutoscaler(None, prof, enabled=True).enabled is False
+
+
+def test_autoscaled_session_runs_narrow_same_output(tmp_path,
+                                                    monkeypatch):
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(4)
+    corpus = write_corpus(tmp_path / "w.txt",
+                          ["to", "be", "or", "not"], 60)
+    # golden: the same script on the full-width daemon
+    gold = Server(port=0, workers=1, comm=mesh,
+                  state_dir=str(tmp_path / "gold"))
+    gold.start()
+    try:
+        gc = ServeClient.local(gold.port)
+        want = gc.wait(gc.submit(script=wf_script(corpus))["id"])
+    finally:
+        gold.shutdown()
+    monkeypatch.setenv("MRTPU_SERVE_MESH_AUTO", "1")
+    srv = Server(port=0, workers=1, comm=mesh,
+                 state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        assert srv.autoscaler.enabled
+        # plant evidence: this tenant's jobs exchange almost nothing
+        srv.profiles.record("tiny", 0.05, 100.0)
+        c = ServeClient.local(srv.port)
+        r = c.submit(script=wf_script(corpus), tenant="tiny")
+        out = c.wait(r["id"])
+        assert out["status"] == "done"
+        assert out["meta"]["mesh_width"] == 1      # ran narrow
+        assert out["output"] == want["output"]     # same answer
+        assert srv.autoscaler.narrowed >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_autoscaler_live_promotion_resharding(tmp_path):
+    """The live rung: a narrow session whose observed exchange volume
+    outgrows its budget is promoted — every named MR reshards onto the
+    full mesh at the next command boundary, later MRs are born wide."""
+    from gpu_mapreduce_tpu.obs.context import RequestAccount
+    from gpu_mapreduce_tpu.oink.script import OinkScript
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    from gpu_mapreduce_tpu.serve.autoscale import MeshAutoscaler
+    full = make_mesh(4)
+    a = MeshAutoscaler(full, CostProfiles(), enabled=True)
+    corpus = write_corpus(tmp_path / "w.txt", ["p", "q", "r"], 40)
+    s = OinkScript(comm=a.mesh_for(1), screen=False)
+    s.run_string(f"variable files index {corpus}\n"
+                 f"wordfreq 3 -i v_files -o NULL wf\n")
+    assert s.obj.named["wf"].backend.nprocs == 1
+    acct = RequestAccount()
+    acct.exchange_sent = 1 << 30          # "observed" heavy shuffle
+    hook = a.promote_hook(acct, 1, on_promote=lambda: None)
+    s.post_cmd.append(hook)
+    s.run_string("wordfreq 3 -i v_files -o NULL wf2\n")
+    assert a.promoted == 1
+    assert hook not in s.post_cmd          # one-shot
+    assert s.obj.named["wf"].backend.nprocs == 4
+    assert s.obj.named["wf2"].backend.nprocs == 4
+    assert s.obj.comm is a.mesh_for(4)
+    # already-wide sessions get no hook at all
+    assert a.promote_hook(acct, 4) is None
+
+
+# ---------------------------------------------------------------------------
+# client + router satellites
+# ---------------------------------------------------------------------------
+
+def test_client_submit_honors_retry_after(tmp_path, monkeypatch):
+    monkeypatch.setenv("MRTPU_SERVE_RATE", "0.5")
+    monkeypatch.setenv("MRTPU_SERVE_BURST", "1")
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 20)
+        assert c.submit(script=wf_script(corpus))["id"]
+        # bucket empty: fail-fast default raises the 429 immediately
+        with pytest.raises(ServeError) as ei:
+            c.submit(script=wf_script(corpus))
+        assert ei.value.code == 429 and ei.value.retry_after >= 1
+        # opt-in bounded wait: sleeps the daemon's hint and succeeds
+        t0 = time.monotonic()
+        r = c.submit(script=wf_script(corpus), retry_after_wait=30.0)
+        assert r["id"] and time.monotonic() - t0 >= 1.0
+        # a budget smaller than the hint never sleeps past it
+        with pytest.raises(ServeError):
+            c.submit(script=wf_script(corpus), retry_after_wait=0.2)
+    finally:
+        srv.shutdown()
+
+
+def test_router_propagates_auth_and_retry_after_verbatim(tmp_path,
+                                                         monkeypatch):
+    """401/403/429 bodies (and per-tenant Retry-After) pass through the
+    router untouched; the bearer header is forwarded so replicas
+    enforce one shared token set; DELETE routes to the owner."""
+    from gpu_mapreduce_tpu.serve.router import Router
+    root = tmp_path / "fleet"
+    monkeypatch.setenv("MRTPU_SERVE_TOKENS", "acme=tok-a")
+    monkeypatch.setenv("MRTPU_SERVE_RATE", "0.2")
+    monkeypatch.setenv("MRTPU_SERVE_BURST", "1")
+    srv = Server(port=0, workers=0, paused=True, fleet_dir=str(root),
+                 replica_id="ra", lease_s=5.0, heartbeat_s=0.5)
+    srv.start()
+    # paused replicas don't route; make this one eligible for the test
+    srv._fleet.renew(state="ready")
+    rt = Router(str(root))
+    rt.start()
+    try:
+        corpus = write_corpus(tmp_path / "w.txt", ["a", "b"], 20)
+        anon = ServeClient.local(rt.port)
+        acme = ServeClient.local(rt.port, token="tok-a")
+        with pytest.raises(ServeError) as ei:
+            anon.submit(script=wf_script(corpus), tenant="acme")
+        assert ei.value.code == 401
+        assert "bearer" in ei.value.body["error"].lower()  # verbatim
+        r = acme.submit(script=wf_script(corpus), tenant="acme")
+        sid = r["id"]
+        # rate-limit 429 through the router keeps the replica's own
+        # per-tenant Retry-After
+        with pytest.raises(ServeError) as ei:
+            acme.submit(script=wf_script(corpus), tenant="acme")
+        assert ei.value.code == 429
+        assert ei.value.retry_after is not None
+        # DELETE proxies to the owner (queued on a paused replica →
+        # finalizes cancelled)
+        assert acme.cancel(sid)["state"] == "cancelled"
+        assert acme.result(sid)["status"] == "cancelled"
+    finally:
+        rt.stop()
+        srv.shutdown()
+
+
+def test_router_healthz_aggregates_degraded(tmp_path):
+    from gpu_mapreduce_tpu.serve.fleet import FleetMember
+    from gpu_mapreduce_tpu.serve.router import Router
+    root = tmp_path / "fleet"
+    os.makedirs(root, exist_ok=True)
+    rt = Router(str(root))
+    # empty fleet: nothing to aggregate
+    assert rt._health() == "unavailable"
+    m = FleetMember(str(root), "ra", lease_s=5.0)
+    m.join(port=1, state_dir=str(root / "replicas" / "ra"))
+    m.renew(state="degraded")
+    # every live replica shedding under pressure → the ROUTER reads
+    # degraded (one curl = the right runbook page)
+    assert rt._health() == "degraded"
+    m.renew(state="ready")
+    assert rt._health() == "ok"
