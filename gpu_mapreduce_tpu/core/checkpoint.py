@@ -185,6 +185,11 @@ def save(mr, path: str) -> int:
         if os.path.exists(path):
             shutil.rmtree(old, ignore_errors=True)
         shutil.rmtree(tmp, ignore_errors=True)
+    # the directory swap is only durable once the PARENT's entry table
+    # is — without this a crash after return can lose the rename of a
+    # generation the journal's ckpt record already references
+    from ..utils.fsio import fsync_dir
+    fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
     return nframes
 
 

@@ -41,6 +41,7 @@ def atomic_save(path: str, arr: np.ndarray, allow_pickle: bool = False
     exact bytes written (utils/integrity.py) — np.save writes strictly
     sequentially, so the stamp costs no read-back pass; readers verify
     it before consuming the file (``core/external._Run``)."""
+    from ..utils.fsio import atomic_replace
     from ..utils.integrity import ChecksumWriter
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -48,7 +49,10 @@ def atomic_save(path: str, arr: np.ndarray, allow_pickle: bool = False
         np.save(cw, arr, allow_pickle=allow_pickle)
         f.flush()
         os.fsync(f.fileno())
-    os.replace(tmp, path)
+    # replace + parent-dir fsync (utils/fsio): without the dir fsync a
+    # crash after the rename can lose the directory entry of a run a
+    # manifest already references — file durable, name not
+    atomic_replace(tmp, path)
     return cw.digest()
 
 

@@ -38,9 +38,15 @@ import zlib
 from typing import Dict, List, Optional
 
 # the registered fault points — every name appears at exactly one real
-# failure site (see doc/reliability.md for the wiring table)
+# failure site (see doc/reliability.md for the wiring table).  The
+# dist.* sites are the collective watchdog's armed sync points
+# (parallel/dist.guard): the ONLY sites where the process-level kinds
+# (peer_kill / peer_hang) make sense, since they simulate a peer dying
+# at — not near — a collective.
 SITES = ("ingest.read", "ingest.tokenize", "spill.write", "spill.read",
-         "shuffle.exchange", "checkpoint.save")
+         "shuffle.exchange", "checkpoint.save",
+         "dist.count_sync", "dist.exchange", "dist.reshard",
+         "dist.ckpt_barrier")
 
 
 class InjectedFault:
@@ -68,23 +74,38 @@ _KINDS = {"oserror": InjectedOSError, "ioerror": InjectedOSError,
           "timeout": InjectedTimeout, "runtime": InjectedRuntimeError,
           "fatal": InjectedFatal}
 
+# process-level kinds: no exception to classify — the PROCESS is the
+# fault.  peer_kill SIGKILLs self at the drawn probe (the k-th sync of
+# a chaos golden, deterministic via after=/n=); peer_hang sleeps past
+# every watchdog deadline (MRTPU_DIST_HANG_S) so survivors must trip on
+# the sync timeout, not a lease expiry.  Restricted to dist.* sites —
+# killing the process at spill.write would just be a worse `fatal`.
+_PROC_KINDS = ("peer_kill", "peer_hang")
+
 
 class FaultSpec:
     """One armed schedule entry: which site(s), how often, what to raise."""
 
     __slots__ = ("site", "rate", "kind", "seed", "max_faults", "after",
-                 "_rngs", "injected", "_probes", "_injected_by_site",
-                 "_from_env")
+                 "rank", "_rngs", "injected", "_probes",
+                 "_injected_by_site", "_from_env")
 
     def __init__(self, site: str = "*", rate: float = 1.0,
                  kind: str = "oserror", seed: int = 0,
-                 max_faults: Optional[int] = None, after: int = 0):
-        if kind not in _KINDS:
+                 max_faults: Optional[int] = None, after: int = 0,
+                 rank: Optional[int] = None):
+        if kind not in _KINDS and kind not in _PROC_KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
-                             f"(one of {sorted(_KINDS)})")
+                             f"(one of {sorted(_KINDS) + list(_PROC_KINDS)})")
         if site != "*" and site not in SITES:
             raise ValueError(f"unknown fault site {site!r} "
                              f"(registered: {SITES})")
+        if kind in _PROC_KINDS and not site.startswith("dist."):
+            raise ValueError(f"kind={kind} only arms at an explicit "
+                             f"dist.* site (got {site!r}) — SIGKILLing "
+                             f"at spill.write would just be a worse "
+                             f"'fatal'")
+        self.rank = None if rank is None else int(rank)
         self.site = site
         self.rate = float(rate)
         self.kind = kind
@@ -104,7 +125,12 @@ class FaultSpec:
         self._from_env = False   # env respec replaces only env specs
 
     def matches(self, site: str) -> bool:
-        return self.site in ("*", site)
+        if self.site not in ("*", site):
+            return False
+        # rank selector: a chaos golden kills ONE chosen rank — every
+        # process runs the same MRTPU_FAULTS string, so the spec itself
+        # must know which rank it is for
+        return self.rank is None or self.rank == _self_rank()
 
     def draw(self, site: str) -> bool:
         """Deterministic verdict for the next probe of ``site``."""
@@ -128,6 +154,19 @@ class FaultSpec:
         return False
 
 
+def _self_rank() -> int:
+    """This process's data-plane rank (0 in single-process runs) —
+    read once from the launcher-set env, not from parallel/dist (the
+    fault layer must stay importable with jax cold)."""
+    global _RANK
+    if _RANK is None:
+        from ..utils.env import env_knob
+        _RANK = env_knob("MRTPU_DIST_RANK", int, 0)
+    return _RANK
+
+
+_RANK: Optional[int] = None
+
 _LOCK = threading.Lock()
 _SPECS: List[FaultSpec] = []
 _ARMED = False           # the fault_point fast-path check
@@ -137,11 +176,11 @@ _COUNTS: Dict[str, int] = {}         # site → faults injected
 
 def schedule(site: str = "*", rate: float = 1.0, kind: str = "oserror",
              seed: int = 0, max_faults: Optional[int] = None,
-             after: int = 0) -> FaultSpec:
+             after: int = 0, rank: Optional[int] = None) -> FaultSpec:
     """Arm one fault spec programmatically; returns it (its ``injected``
     count is live).  ``ft.clear_faults()`` disarms everything."""
     global _ARMED
-    spec = FaultSpec(site, rate, kind, seed, max_faults, after)
+    spec = FaultSpec(site, rate, kind, seed, max_faults, after, rank)
     with _LOCK:
         _SPECS.append(spec)
         _ARMED = True
@@ -200,6 +239,8 @@ def parse_faults(text: str) -> List[FaultSpec]:
               "after": int(fields.pop("after", 0))}
         if "n" in fields:
             kw["max_faults"] = int(fields.pop("n"))
+        if "rank" in fields:
+            kw["rank"] = int(fields.pop("rank"))
         if fields:
             raise ValueError(f"unknown MRTPU_FAULTS fields "
                              f"{sorted(fields)}")
@@ -246,16 +287,41 @@ def fault_point(site: str, **detail) -> None:
             if spec.matches(site) and spec.draw(site):
                 spec.injected += 1
                 _COUNTS[site] = _COUNTS.get(site, 0) + 1
-                exc_cls, kind = _KINDS[spec.kind], spec.kind
+                kind = spec.kind
+                exc_cls = _KINDS.get(kind)
                 break
         else:
             return
+    if exc_cls is None:            # peer_kill / peer_hang
+        _proc_fault(kind, site)
+        return
     exc = exc_cls(f"injected {kind} fault at {site}"
                   + (f" ({detail})" if detail else ""))
     exc.ft_site = site
     from ..obs import get_tracer
     with get_tracer().span("ft.inject", cat="ft", site=site, kind=kind):
         raise exc
+
+
+def _proc_fault(kind: str, site: str) -> None:
+    """Execute a process-level fault: the chaos goldens' deterministic
+    stand-ins for a rank SIGKILLed (OOM-killer, preemption) or wedged
+    (NIC death, livelock) exactly AT a collective sync point."""
+    import sys
+    import time as _time
+    print(f"ft.inject: {kind} at {site} (rank {_self_rank()}, "
+          f"pid {__import__('os').getpid()})", file=sys.stderr, flush=True)
+    if kind == "peer_kill":
+        import os as _os
+        import signal as _signal
+        _os.kill(_os.getpid(), _signal.SIGKILL)
+        return                      # unreachable
+    # peer_hang: sleep past every watchdog deadline so survivors must
+    # trip on the sync timeout; the sleep happens ON the sync path (the
+    # main thread), so our own heartbeat thread keeps beating — the
+    # hardest detection case, by design
+    from ..utils.env import env_knob
+    _time.sleep(env_knob("MRTPU_DIST_HANG_S", float, 3600.0))
 
 
 def counts() -> Dict[str, int]:

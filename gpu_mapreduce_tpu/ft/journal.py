@@ -88,7 +88,14 @@ class Journal:
                 sealed = f.read(1) == b"\n"
         except (OSError, ValueError):
             pass        # missing or empty file — nothing to seal
+        created = not os.path.exists(self.path)
         self._f = open(self.path, "a")
+        if created:
+            # make the journal FILE's directory entry durable at birth:
+            # its first ckpt record is worthless if a crash can lose
+            # the file name itself (utils/fsio rename-durability rule)
+            from ..utils.fsio import fsync_dir
+            fsync_dir(dir)
         if not sealed:
             self._f.write("\n")
             self._f.flush()

@@ -170,6 +170,10 @@ class Tracer:
             counters = global_counters()
         self.enabled = False
         self.counters = counters
+        # process-wide attrs merged into EVERY span (parallel/dist.py
+        # stamps rank= here so one multi-rank trace merge stays
+        # attributable without threading rank through call signatures)
+        self.proc_attrs: dict = {}
         self.jax_annotations = env_flag("MRTPU_TRACE_JAX", True)
         self.epoch = time.perf_counter()
         self.pid = os.getpid()
@@ -186,6 +190,8 @@ class Tracer:
         singleton when disabled (the zero-cost fast path)."""
         if not self.enabled:
             return NULL_SPAN
+        if self.proc_attrs:
+            attrs = {**self.proc_attrs, **attrs}
         return Span(self, name, cat, attrs)
 
     def annotate(self, **attrs) -> None:
@@ -201,6 +207,11 @@ class Tracer:
     def current(self):
         stack = self._stack() if self.enabled else None
         return stack[-1] if stack else None
+
+    def set_proc_attrs(self, **attrs) -> None:
+        """Merge process-wide span attrs (e.g. ``rank=3``) — stamped on
+        every span this tracer creates from now on."""
+        self.proc_attrs.update(attrs)
 
     # -- configuration ------------------------------------------------------
     def enable(self, jsonl: Optional[str] = None, ring: Optional[int] = None):

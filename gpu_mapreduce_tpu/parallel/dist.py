@@ -1,0 +1,613 @@
+"""Multi-process data plane — the mesh finally leaves one process.
+
+MR-MPI is multi-node by construction (every op ends in MPI collectives
+across OS processes); until now this reproduction ran its collectives on
+a fake mesh inside one process.  This module is the process-spanning
+runtime: ``jax.distributed.initialize`` coordinator bootstrap over N
+local CPU processes (gloo cross-process collectives + forced
+host-platform device counts emulate multi-host — the same
+multi-controller code path a TPU pod uses), over which the existing
+shuffle exchange, wire codec and range-exchange programs run unchanged
+as collective programs.
+
+And then it survives its peers.  The moment the mesh spans processes, a
+SIGKILLed or hung rank turns every ``all_to_all`` into an unbounded
+stall on every survivor — a failure class no retry budget can see,
+because nothing *fails*.  Three mechanisms convert that stall into a
+bounded, recoverable error:
+
+* **heartbeats** — every rank's :class:`Heartbeat` thread renews an
+  fsync'd lease file under ``<rundir>/hb/`` (the serve/fleet.py lease
+  idiom: tmp + fsync + rename + dir fsync, expiry + skew margin).  A
+  rank whose lease passes expiry + ``MRTPU_DIST_SKEW`` is presumed
+  dead.
+* **collective watchdog** — :meth:`DistRuntime.guard` wraps every host
+  sync point (phase-1 count pull, exchange, reshard, checkpoint
+  barrier): the blocking call runs on a worker thread while the guard
+  polls peer leases, the rank's own fence, and a hard deadline
+  (``MRTPU_DIST_SYNC_TIMEOUT`` — the only way to catch a peer that is
+  *hung but still heartbeating*).  A dead peer surfaces as
+  :class:`PeerLostError` on every survivor within
+  ``lease + skew + poll`` seconds, never an infinite stall.
+* **fencing** — survivors (or the launcher) create
+  ``<rundir>/hb/rank<k>.fence.json`` with ``O_CREAT|O_EXCL`` before the
+  shrunk generation resumes.  A fenced rank that was merely hung and
+  wakes up later discovers the fence at its next heartbeat or sync
+  point (:class:`RankFencedError`) and exits without touching output —
+  the same epoch-fence discipline serve/fleet.py applies to journal
+  claims, so a zombie double-writing a survivor's output is
+  structurally impossible, not just unlikely.
+
+Shrink-and-resume is launcher-driven (``scripts/mrlaunch.py``): the
+coordinator of a failed generation cannot be re-used (survivors' gloo
+contexts hold dead TCP peers), so survivors exit with
+:data:`EXIT_PEER_LOST`, and the launcher fences the dead rank, picks
+:func:`shrink_width` (largest power of two ≤ survivors — the same
+power-of-two mesh rule the rest of the tree compiles for), and
+relaunches a fresh generation that ``ft.resume``-style restores from
+the last durable checkpoint manifest.  Chaos is deterministic via
+ft/inject's process-level kinds (``peer_kill``/``peer_hang`` +
+``rank=`` selector) at the ``dist.*`` sites this module probes.
+
+Single-process behavior is untouched: with no ``MRTPU_DIST_WORLD`` the
+module never initializes anything, :func:`active` is None, and
+:func:`host_pull`/:func:`guard_call` are direct passthroughs.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..core.runtime import MRError
+from ..utils.env import env_knob, env_str
+
+# launcher/worker exit protocol: a survivor that detected a dead peer
+# exits PEER_LOST (the launcher shrinks); a fenced zombie exits FENCED
+# (the launcher ignores it — its rank was already failed over)
+EXIT_PEER_LOST = 75
+EXIT_FENCED = 76
+
+_HB_DIR = "hb-g"       # per-GENERATION heartbeat/fence dir: a fence
+#                        for gen g's rank 2 must never fence the next
+#                        generation's (re-used) rank number
+_HB_SUF = ".hb.json"
+_FENCE_SUF = ".fence.json"
+_EXIT_SUF = ".exit.json"
+
+
+class PeerLostError(MRError):
+    """A collective sync point detected dead/hung peer rank(s): the
+    bounded-time replacement for an infinite ``all_to_all`` stall."""
+
+    def __init__(self, site: str, dead: List[int], reason: str = ""):
+        self.site = site
+        self.dead = list(dead)
+        super().__init__(
+            f"peer rank(s) {self.dead or '?'} lost at sync point "
+            f"{site!r}{': ' + reason if reason else ''}")
+
+
+class RankFencedError(MRError):
+    """THIS rank was fenced (a shrunk generation took over its work):
+    it must stop without writing output — the anti-zombie guard."""
+
+    def __init__(self, rank: int, site: str = ""):
+        self.rank = rank
+        super().__init__(
+            f"rank {rank} is fenced (superseded by a shrunk generation)"
+            + (f" at {site!r}" if site else ""))
+
+
+def shrink_width(survivors: int) -> int:
+    """Mesh width for the next generation: the largest power of two
+    ≤ ``survivors`` (power-of-two meshes are what every capacity /
+    round_cap policy in the tree compiles for; running 3-wide would
+    trade one dead rank for a fleet of fresh compiles)."""
+    if survivors < 1:
+        return 0
+    w = 1
+    while w * 2 <= survivors:
+        w *= 2
+    return w
+
+
+# ---------------------------------------------------------------------------
+# heartbeat + fence files (the fleet lease idiom on the data plane)
+# ---------------------------------------------------------------------------
+
+def hb_dir(rundir: str, gen: int = 0) -> str:
+    return os.path.join(rundir, f"{_HB_DIR}{gen}")
+
+
+def hb_path(rundir: str, rank: int, gen: int = 0) -> str:
+    return os.path.join(hb_dir(rundir, gen), f"rank{rank}{_HB_SUF}")
+
+
+def fence_path(rundir: str, rank: int, gen: int = 0) -> str:
+    return os.path.join(hb_dir(rundir, gen), f"rank{rank}{_FENCE_SUF}")
+
+
+def exit_path(rundir: str, rank: int, gen: int = 0) -> str:
+    return os.path.join(hb_dir(rundir, gen), f"rank{rank}{_EXIT_SUF}")
+
+
+def write_beat(rundir: str, rank: int, lease_s: float, gen: int = 0,
+               state: str = "ready", seq: int = 0) -> None:
+    """One durable heartbeat: the lease every peer's death verdict (and
+    the launcher's recovery clock) reads."""
+    from ..utils.fsio import atomic_write_json
+    os.makedirs(hb_dir(rundir, gen), exist_ok=True)
+    now = time.time()
+    atomic_write_json(hb_path(rundir, rank, gen), {
+        "rank": rank, "pid": os.getpid(), "gen": gen, "state": state,
+        "seq": seq, "ts": now, "ttl": lease_s, "expires": now + lease_s})
+
+
+def read_beat(rundir: str, rank: int, gen: int = 0) -> Optional[dict]:
+    from ..utils.fsio import read_json
+    return read_json(hb_path(rundir, rank, gen))
+
+
+def write_exit_report(rundir: str, rank: int, gen: int, code: str,
+                      dead: Optional[List[int]] = None,
+                      site: str = "") -> None:
+    """A survivor's last word before exiting: which peers it observed
+    dead at which sync point — the launcher unions these reports with
+    child exit codes to name the dead rank(s) of a generation."""
+    from ..utils.fsio import atomic_write_json
+    try:
+        atomic_write_json(exit_path(rundir, rank, gen), {
+            "rank": rank, "gen": gen, "code": code,
+            "dead": list(dead or []), "site": site, "ts": time.time()})
+    except OSError:
+        pass                 # best-effort: the exit code still speaks
+
+
+def beat_expired(beat: Optional[dict], skew_s: float,
+                 now: Optional[float] = None) -> bool:
+    """Dead once past ``expires + skew`` — clock disagreement under the
+    margin can never fail over a live rank; an unreadable/missing beat
+    protects nobody and counts as expired."""
+    if beat is None:
+        return True
+    now = time.time() if now is None else now
+    try:
+        return now > float(beat["expires"]) + skew_s
+    except (KeyError, TypeError, ValueError):
+        return True
+
+
+def fence_rank(rundir: str, rank: int, by: str, gen: int = 0) -> bool:
+    """Fence ``rank``: O_CREAT|O_EXCL + dir fsync, exactly like a fleet
+    journal claim — the filesystem arbitrates concurrent fencers, and
+    the fence's existence (not its content) is the verdict a zombie
+    reads.  Returns whether WE created it (False: already fenced —
+    equally final, not an error)."""
+    import json as _json
+
+    from ..utils.fsio import fsync_dir
+    os.makedirs(hb_dir(rundir, gen), exist_ok=True)
+    path = fence_path(rundir, rank, gen)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    try:
+        os.write(fd, _json.dumps(
+            {"rank": rank, "by": by, "gen": gen,
+             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                  time.gmtime())}).encode())
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    fsync_dir(hb_dir(rundir, gen))
+    return True
+
+
+def is_fenced(rundir: str, rank: int, gen: int = 0) -> bool:
+    return os.path.exists(fence_path(rundir, rank, gen))
+
+
+class Heartbeat:
+    """One rank's lease writer thread.  Beats every ``heartbeat_s``;
+    each beat also checks the rank's own fence and latches
+    ``self.fenced`` so sync points see a takeover within one beat even
+    between collectives."""
+
+    def __init__(self, rundir: str, rank: int, *, heartbeat_s: float,
+                 lease_s: float, gen: int = 0):
+        self.rundir = rundir
+        self.rank = rank
+        self.heartbeat_s = heartbeat_s
+        self.lease_s = lease_s
+        self.gen = gen
+        self.seq = 0
+        self.fenced = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        os.makedirs(hb_dir(self.rundir, self.gen), exist_ok=True)
+        self.beat_once()              # beat 0 lands BEFORE any collective
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"mrtpu-dist-hb-r{self.rank}")
+        self._thread.start()
+
+    def beat_once(self) -> None:
+        self.seq += 1
+        write_beat(self.rundir, self.rank, self.lease_s, gen=self.gen,
+                   seq=self.seq)
+        if is_fenced(self.rundir, self.rank, self.gen):
+            self.fenced = True
+        try:
+            from ..obs.metrics import get_registry
+            get_registry().counter(
+                "mrtpu_dist_heartbeats_total",
+                "data-plane heartbeats written by this rank").inc()
+        except Exception:
+            pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self.beat_once()
+            except OSError:
+                # a failed beat must not kill the data plane thread —
+                # peers will judge us by the last durable lease; if the
+                # disk stays broken we expire honestly
+                pass
+
+    def stop(self, leave: bool = True) -> None:
+        """Stop beating; ``leave`` removes the lease (a clean exit is
+        not a death — peers should not see an expiry to claim)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.heartbeat_s + 1.0)
+        if leave:
+            try:
+                os.remove(hb_path(self.rundir, self.rank, self.gen))
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the runtime
+# ---------------------------------------------------------------------------
+
+class DistRuntime:
+    """This process's membership in the multi-process data plane."""
+
+    def __init__(self, rank: int, world: int, rundir: str, *,
+                 heartbeat_s: Optional[float] = None,
+                 lease_s: Optional[float] = None,
+                 skew_s: Optional[float] = None,
+                 sync_timeout_s: Optional[float] = None,
+                 gen: int = 0):
+        self.rank = rank
+        self.world = world
+        self.rundir = rundir
+        self.gen = gen
+        self.heartbeat_s = heartbeat_s if heartbeat_s is not None else \
+            env_knob("MRTPU_DIST_HEARTBEAT", float, 0.25)
+        self.lease_s = lease_s if lease_s is not None else \
+            env_knob("MRTPU_DIST_LEASE", float, 1.5)
+        self.skew_s = skew_s if skew_s is not None else \
+            env_knob("MRTPU_DIST_SKEW", float, 0.25)
+        self.sync_timeout_s = sync_timeout_s if sync_timeout_s is not None \
+            else env_knob("MRTPU_DIST_SYNC_TIMEOUT", float, 60.0)
+        self.heartbeat = Heartbeat(rundir, rank,
+                                   heartbeat_s=self.heartbeat_s,
+                                   lease_s=self.lease_s, gen=gen)
+        self.peer_lost: Optional[PeerLostError] = None
+
+    # -- observation -------------------------------------------------------
+    def peer_ranks(self) -> List[int]:
+        return [r for r in range(self.world) if r != self.rank]
+
+    def dead_peers(self, now: Optional[float] = None) -> List[int]:
+        now = time.time() if now is None else now
+        return [r for r in self.peer_ranks()
+                if beat_expired(read_beat(self.rundir, r, self.gen),
+                                self.skew_s, now)]
+
+    def fenced(self) -> bool:
+        return self.heartbeat.fenced or \
+            is_fenced(self.rundir, self.rank, self.gen)
+
+    # -- the watchdog ------------------------------------------------------
+    def guard(self, site: str, fn: Callable, *args, **kwargs):
+        """Run ``fn`` (a host sync point: count pull, exchange dispatch
+        + block, reshard, checkpoint barrier) under the collective
+        watchdog.  Returns ``fn``'s result, or raises:
+
+        * :class:`RankFencedError` — WE were fenced (zombie guard);
+        * :class:`PeerLostError` — a peer's lease expired, the sync
+          deadline passed (hung-but-heartbeating peer), or ``fn``
+          failed while a peer was dying (the transport saw the death
+          first — confirmed against leases within one expiry window).
+
+        The blocking call runs on a daemon worker thread so a peer that
+        is already dead cannot pin this thread forever: on a trip the
+        worker is abandoned mid-collective (the process is about to
+        exit with :data:`EXIT_PEER_LOST`; nothing reuses the wedged
+        gloo context)."""
+        from ..ft.inject import fault_point
+        fault_point(f"dist.{site}")
+        if self.fenced():
+            self._note_fenced(site)
+            raise RankFencedError(self.rank, site)
+
+        done = threading.Event()
+        box: list = [None, None]     # [result, exception]
+
+        def _work():
+            try:
+                box[0] = fn(*args, **kwargs)
+            except BaseException as e:        # noqa: BLE001 — re-raised
+                box[1] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_work, daemon=True,
+                             name=f"mrtpu-dist-sync-{site}")
+        t0 = time.monotonic()
+        t.start()
+        poll = max(0.05, self.heartbeat_s / 2.0)
+        while not done.wait(poll):
+            if self.fenced():
+                self._note_fenced(site)
+                raise RankFencedError(self.rank, site)
+            dead = self.dead_peers()
+            if dead:
+                self._trip(site, dead, "lease expired")
+            if time.monotonic() - t0 > self.sync_timeout_s:
+                self._trip(site, self.dead_peers(),
+                           f"sync deadline {self.sync_timeout_s:g}s "
+                           f"passed (hung peer?)")
+        if box[1] is not None:
+            # the transport may observe a dying peer before its lease
+            # expires (connection reset beats the expiry clock): give
+            # the leases one expiry window to confirm, then convert —
+            # otherwise the original error propagates untouched.  A
+            # peerless (shrunk-to-1) runtime skips the window: there is
+            # no lease that could ever confirm anything
+            if self.peer_ranks():
+                deadline = time.time() + self.lease_s + self.skew_s
+                while time.time() < deadline:
+                    dead = self.dead_peers()
+                    if dead:
+                        self._trip(site, dead,
+                                   f"transport error {box[1]!r}")
+                    time.sleep(poll)
+            raise box[1]
+        return box[0]
+
+    def _trip(self, site: str, dead: List[int], reason: str):
+        err = PeerLostError(site, dead, reason)
+        self.peer_lost = err
+        try:
+            from ..obs import get_tracer
+            from ..obs.metrics import get_registry
+            reg = get_registry()
+            reg.counter(
+                "mrtpu_dist_watchdog_trips_total",
+                "collective watchdog trips (a sync point detected a "
+                "dead/hung peer instead of stalling)", ("site",)
+            ).inc(site=site)
+            reg.counter(
+                "mrtpu_dist_peer_lost_total",
+                "peer ranks lost (as observed by this rank)"
+            ).inc(max(1, len(dead)))
+            with get_tracer().span("dist.peer_lost", cat="dist",
+                                   site=site, rank=self.rank,
+                                   dead=list(dead)):
+                pass
+        except Exception:
+            pass
+        raise err
+
+    def _note_fenced(self, site: str):
+        try:
+            from ..obs.metrics import get_registry
+            get_registry().counter(
+                "mrtpu_dist_fenced_total",
+                "sync points this rank declined because it was fenced "
+                "(zombie double-execution guard)", ("site",)
+            ).inc(site=site)
+        except Exception:
+            pass
+
+    def stop(self, leave: bool = True) -> None:
+        self.heartbeat.stop(leave=leave)
+
+
+_ACTIVE: Optional[DistRuntime] = None
+_LOCK = threading.Lock()
+
+
+def active() -> Optional[DistRuntime]:
+    return _ACTIVE
+
+
+def activate(rt: Optional[DistRuntime]) -> Optional[DistRuntime]:
+    global _ACTIVE
+    with _LOCK:
+        prev, _ACTIVE = _ACTIVE, rt
+    return prev
+
+
+def init_from_env() -> Optional[DistRuntime]:
+    """Join the multi-process data plane if ``MRTPU_DIST_WORLD`` > 1:
+    force the host-platform device count, select gloo cross-process CPU
+    collectives, ``jax.distributed.initialize`` against the launcher's
+    coordinator, start heartbeating, and install the runtime (rank-
+    tagging every span via the tracer's process attrs).  MUST run
+    before any other jax use in the process — the launcher guarantees
+    this by making it the worker's first call.  Returns None (and
+    touches nothing) in single-process runs."""
+    world = env_knob("MRTPU_DIST_WORLD", int, 0)
+    rundir = env_str("MRTPU_DIST_RUNDIR", "")
+    if world < 1 or (world == 1 and not rundir):
+        return None
+    rank = env_knob("MRTPU_DIST_RANK", int, 0)
+    coord = env_str("MRTPU_DIST_COORD", "")
+    gen = env_knob("MRTPU_DIST_GEN", int, 0)
+    if world > 1 and (not coord or not rundir):
+        raise MRError("MRTPU_DIST_WORLD is set but MRTPU_DIST_COORD / "
+                      "MRTPU_DIST_RUNDIR are not — use scripts/"
+                      "mrlaunch.py (doc/distributed.md)")
+    ndev = env_knob("MRTPU_DIST_LOCAL_DEVICES", int, 1)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={ndev}"
+        ).strip()
+    import jax
+    if world > 1:
+        # a shrunk-to-1 generation needs NO coordinator or gloo: its
+        # mesh is local, and jax.distributed would just add the
+        # coordination service's own failure modes back in
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except (AttributeError, ValueError):
+            # jax ≥0.5 renamed/retired the flag (gloo became the
+            # default for multiprocess CPU); a TPU backend never
+            # needed it
+            pass
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=world, process_id=rank)
+    # arm MRTPU_FAULTS here: chaos workers drive the collective tier
+    # directly and never construct a MapReduce (the usual arming site)
+    from ..ft.inject import configure_from_env
+    configure_from_env()
+    rt = DistRuntime(rank, world, rundir, gen=gen)
+    rt.heartbeat.start()
+    activate(rt)
+    try:
+        from ..obs import get_tracer
+        from ..obs.metrics import get_registry
+        get_tracer().set_proc_attrs(rank=rank)
+        reg = get_registry()
+        reg.gauge("mrtpu_dist_world",
+                  "process count of the active data plane").set(world)
+        reg.gauge("mrtpu_dist_rank",
+                  "this process's rank in the data plane").set(rank)
+        reg.gauge("mrtpu_dist_gen",
+                  "shrink generation of the active data plane (0 = "
+                  "first launch)").set(gen)
+    except Exception:
+        pass
+    return rt
+
+
+def guard_call(site: str, fn: Callable, *args, **kwargs):
+    """Watchdog-wrapped ``fn`` when the data plane is active, direct
+    call otherwise — the zero-overhead spelling library sync points use
+    (parallel/shuffle count pull, reshard, checkpoint barriers)."""
+    rt = _ACTIVE
+    if rt is None:
+        return fn(*args, **kwargs)
+    return rt.guard(site, fn, *args, **kwargs)
+
+
+def surviving_width() -> Optional[int]:
+    """The mesh-width cap after a shrink: the active runtime's world,
+    or the launcher/operator-set ``MRTPU_DIST_WIDTH_CAP`` (how a serve
+    daemon that is NOT itself a data-plane rank learns the fleet
+    degraded).  None = uncapped."""
+    rt = _ACTIVE
+    if rt is not None:
+        return rt.world
+    cap = env_knob("MRTPU_DIST_WIDTH_CAP", int, 0)
+    return cap if cap > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# multi-controller host pulls
+# ---------------------------------------------------------------------------
+
+def host_pull(arr, mesh=None):
+    """``np.asarray`` that works across process-spanning meshes.
+
+    A sharded global array spans non-addressable devices in
+    multi-controller runs, so a direct ``np.asarray`` raises.  When the
+    data plane is active and the array isn't fully addressable, run a
+    compiled identity resharded to fully-replicated (an all_gather —
+    every controller then holds every shard) and pull that.  Single-
+    process: a plain ``np.asarray``, zero extra dispatch."""
+    import numpy as np
+    if _ACTIVE is None:
+        return np.asarray(arr)
+    try:
+        fully = bool(getattr(arr, "is_fully_addressable", True)
+                     or getattr(arr, "is_fully_replicated", False))
+    except Exception:
+        fully = True
+    if fully:
+        return np.asarray(arr)
+    from jax.sharding import NamedSharding, PartitionSpec
+    sharding = getattr(arr, "sharding", None)
+    m = mesh if mesh is not None else getattr(sharding, "mesh", None)
+    if m is None:
+        return np.asarray(arr)       # let jax raise its own error
+    return np.asarray(_replicate_jit(NamedSharding(m, PartitionSpec()))
+                      (arr))
+
+
+# one jitted replicate-identity per output sharding: a fresh lambda per
+# pull would retrace+recompile the all-gather on EVERY count sync —
+# the data plane's one mandatory barrier per op
+_REP_JITS: dict = {}
+
+
+def _replicate_jit(rep):
+    fn = _REP_JITS.get(rep)
+    if fn is None:
+        import jax
+        with _LOCK:
+            fn = _REP_JITS.get(rep)
+            if fn is None:
+                if len(_REP_JITS) >= 32:       # churny meshes: bounded
+                    _REP_JITS.clear()
+                fn = _REP_JITS[rep] = jax.jit(lambda x: x,
+                                              out_shardings=rep)
+    return fn
+
+
+def shard_local_rows(mesh, local_rows, counts):
+    """Build a [P*cap, ...] row-sharded global array where THIS process
+    contributes ``local_rows`` for its addressable shard(s) — the
+    multi-controller twin of ``sharded.shard_frame_with_counts`` (which
+    needs the whole host array and cannot run on one controller).
+
+    ``counts[P]`` must be the globally-agreed per-shard valid counts
+    (every rank computes the same vector from the same metadata — the
+    launcher's deterministic slicing makes that free).  ``local_rows``
+    is a list of one host block per addressable shard, in shard order;
+    blocks are padded to the common power-of-two cap here."""
+    import jax
+    import numpy as np
+
+    from .mesh import row_sharding
+    from .sharded import _pad_rows, round_cap
+    counts = np.asarray(counts)
+    cap = round_cap(int(counts.max()) if counts.size else 0)
+    sharding = row_sharding(mesh)
+    P = int(counts.shape[0])
+    first = np.asarray(local_rows[0])
+    shape = (P * cap,) + first.shape[1:]
+    dmap = sharding.addressable_devices_indices_map(shape)
+    devs = sorted(dmap.items(),
+                  key=lambda di: (di[1][0].start or 0))
+    if len(devs) != len(local_rows):
+        raise MRError(f"shard_local_rows: {len(local_rows)} local "
+                      f"blocks for {len(devs)} addressable shards")
+    shards = [jax.device_put(_pad_rows(np.asarray(block), cap), dev)
+              for (dev, _idx), block in zip(devs, local_rows)]
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding, shards), cap

@@ -683,10 +683,20 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
         # the host pull that sizes the exchange — with a speculative
         # phase 2 in flight this overlaps device work.  The wire stats
         # ride the same sync point (a second small transfer, not a
-        # second barrier)
-        counts_mat = np.asarray(counts_local).reshape(nprocs, nprocs)
-        stats_mat = (np.asarray(stats_local).reshape(nprocs, nprocs, 4)
-                     if stats_local is not None else None)
+        # second barrier).  Multi-process runs (parallel/dist.py) route
+        # through host_pull (the count matrix spans non-addressable
+        # devices there) under the collective watchdog — a dead peer
+        # turns this, the op's one mandatory barrier, into a bounded
+        # PeerLostError instead of an unbounded stall
+        from . import dist as _dist
+
+        def _pull():
+            cm = _dist.host_pull(counts_local).reshape(nprocs, nprocs)
+            sm = (_dist.host_pull(stats_local).reshape(nprocs, nprocs, 4)
+                  if stats_local is not None else None)
+            return cm, sm
+
+        counts_mat, stats_mat = _dist.guard_call("count_sync", _pull)
     # round budget: pad buckets to ~the mean nonzero bucket, not the max —
     # under key skew (RMAT hubs) the max bucket is far above the mean and
     # single-round padding would inflate the exchanged volume by that

@@ -45,12 +45,22 @@ class MeshAutoscaler:
         self._lock = threading.Lock()
         self.narrowed = 0
         self.promoted = 0
+        self.dist_cap: Optional[int] = None
         if comm is None or isinstance(comm, int):
             self.enabled = False         # serial backend: nothing to size
             return
         from ..parallel.mesh import mesh_axis_size
         self.full_width = mesh_axis_size(comm)
         self._meshes[self.full_width] = comm
+        # degraded data plane (parallel/dist.py): after a shrink the
+        # fleet's surviving width caps every session mesh — "full" is
+        # whatever actually survives, not what the hardware once was
+        from ..parallel.dist import surviving_width
+        cap = surviving_width()
+        self.dist_cap = cap if cap and cap < self.full_width else None
+        if self.dist_cap:
+            self.full_width = self.dist_cap
+            self.full = self.mesh_for(self.dist_cap)
         if self.full_width <= 1:
             self.enabled = False
 
@@ -136,4 +146,5 @@ class MeshAutoscaler:
 
     def snapshot(self) -> dict:
         return {"enabled": self.enabled, "full_width": self.full_width,
-                "narrowed": self.narrowed, "promoted": self.promoted}
+                "narrowed": self.narrowed, "promoted": self.promoted,
+                "dist_cap": self.dist_cap}

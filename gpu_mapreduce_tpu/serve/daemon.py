@@ -1279,6 +1279,22 @@ class Server:
         from ..parallel.mesh import mesh_axis_size
         return mesh_axis_size(self.comm)
 
+    def _mesh_status(self) -> dict:
+        """The stats()/mrctl view of the mesh, including whether the
+        data plane is running DEGRADED (shrunk after a rank loss —
+        parallel/dist.py): operators must see a narrowed fleet in the
+        same place they see width, not infer it from missing ranks."""
+        from ..parallel.dist import surviving_width
+        out = {"nprocs": self._mesh_width()}
+        cap = surviving_width()
+        if cap is not None and cap < out["nprocs"]:
+            out["degraded"] = True
+            out["surviving_width"] = cap
+        elif getattr(self.autoscaler, "dist_cap", None):
+            out["degraded"] = True
+            out["surviving_width"] = self.autoscaler.dist_cap
+        return out
+
     # -- request-scoped observability (obs/context.py) ---------------------
     def _span_feed(self, ev: dict) -> None:
         """Tracer sink: a finished TOP-LEVEL span whose trace_id maps
@@ -1448,7 +1464,7 @@ class Server:
                 "tenants": self.budgets.snapshot(),
                 "ratelimit": self.ratelimit.snapshot(),
                 "gc": {"ttl_s": self.ttl_s, "swept": self.gc_count},
-                "mesh": {"nprocs": self._mesh_width()},
+                "mesh": self._mesh_status(),
                 "plan": cache_stats(),
                 # the self-protection plane (doc/serve.md): auth arming,
                 # shed/deprioritize counts, cost evidence, disk

@@ -51,23 +51,17 @@ _CLAIM_MID = ".claim-"
 
 
 def _atomic_write(path: str, obj: dict) -> None:
-    """tmp + fsync + rename — a crash mid-heartbeat can tear only the
-    ``.tmp``, never the lease a peer's expiry decision reads."""
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    """tmp + fsync + rename + parent-dir fsync (utils/fsio) — a crash
+    mid-heartbeat can tear only the ``.tmp``, never the lease a peer's
+    expiry decision reads, and a crash after the rename cannot lose the
+    directory entry either."""
+    from ..utils.fsio import atomic_write_json
+    atomic_write_json(path, obj)
 
 
 def _read_json(path: str) -> Optional[dict]:
-    try:
-        with open(path) as f:
-            out = json.load(f)
-        return out if isinstance(out, dict) else None
-    except (OSError, ValueError):
-        return None
+    from ..utils.fsio import read_json
+    return read_json(path)
 
 
 def ring_hash(key: str) -> int:
@@ -337,6 +331,10 @@ class FleetMember:
             os.fsync(fd)
         finally:
             os.close(fd)
+        # the claim's EXISTENCE is the fence — make the directory entry
+        # durable before acting on the takeover (utils/fsio discipline)
+        from ..utils.fsio import fsync_dir
+        fsync_dir(self.dir)
         return rec
 
     def claim_done(self, dead_rid: str, gen: int) -> None:

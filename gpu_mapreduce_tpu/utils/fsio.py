@@ -1,0 +1,78 @@
+"""Durable file primitives — rename is not enough.
+
+Every crash-safe writer in this tree follows tmp + fsync + rename, which
+guarantees the final path never holds a torn file.  What rename alone
+does NOT guarantee is that the new DIRECTORY ENTRY survives a power cut:
+POSIX only promises the entry is durable once the parent directory
+itself has been fsync'd.  A checkpoint shard that a manifest already
+references, a fleet lease a peer's expiry decision reads, a journal
+file a resume depends on — all can silently vanish on crash-after-
+rename, which is exactly the failure class the writers exist to close.
+
+This module is the ONE place the rename-durability discipline lives:
+
+* :func:`fsync_dir` — fsync a directory fd (no-op where the platform
+  refuses, e.g. some network filesystems raise EINVAL on dir fds).
+* :func:`atomic_replace` — ``os.replace`` + parent-dir fsync.
+* :func:`atomic_write_json` — tmp + flush + fsync + replace + dir
+  fsync; the lease/heartbeat/manifest writer.
+
+Callers that already fsync'd the tmp file's CONTENTS only need the
+replace + dir step; the content fsync stays at the call site so the
+write path reads top-to-bottom there.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory at ``path`` so renames/creates inside it are
+    durable.  Best-effort: platforms/filesystems that reject directory
+    fsync (EINVAL/EBADF on some NFS mounts) degrade silently — the
+    rename itself already happened, so behavior is never worse than the
+    pre-fsync code."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_replace(tmp: str, path: str) -> None:
+    """``os.replace(tmp, path)`` + parent-dir fsync: the new name is
+    durable when this returns, not just present."""
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(os.path.abspath(path)))
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    """Durable whole-file JSON write: tmp + content fsync + atomic
+    replace + parent-dir fsync.  A reader never sees a torn file AND a
+    crash immediately after return cannot un-write it — the contract
+    heartbeats, leases and checkpoint manifests are built on."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    atomic_replace(tmp, path)
+
+
+def read_json(path: str):
+    """Best-effort JSON read: the parsed dict, or None on a missing,
+    torn, or non-dict file (a torn read must never crash an expiry or
+    resume decision — absence is the safe verdict)."""
+    try:
+        with open(path) as f:
+            out = json.load(f)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None

@@ -14,6 +14,9 @@
 #   scripts/ci.sh lint     # mrlint only (all 5 rules, whole package)
 #   scripts/ci.sh fleet    # serve-fleet subset only (lease/ring units
 #                          # + kill -9 failover goldens + router)
+#   scripts/ci.sh dist     # multi-process data plane subset (watchdog/
+#                          # heartbeat fakes + slow multi-rank goldens:
+#                          # peer_kill shrink-and-resume, peer_hang)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -129,6 +132,18 @@ run_overload_subset_full() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_dist_subset_quick() {
+  echo "== dist subset (fast): watchdog/heartbeat/fence fakes, fault kinds, launcher units =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_dist.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_dist_subset_full() {
+  echo "== dist subset (full): multi-process goldens (peer_kill shrink-and-resume, peer_hang watchdog) =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_dist.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_fleet_subset_quick() {
   echo "== fleet subset (fast): lease/claim/ring units + router + satellites =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
@@ -160,6 +175,12 @@ if [ "${1:-}" = "fleet" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "dist" ]; then
+  run_dist_subset_quick
+  run_dist_subset_full
+  exit 0
+fi
+
 if [ "${1:-}" = "quick" ]; then
   run_lint_quick
   run_plan_subset
@@ -169,6 +190,7 @@ if [ "${1:-}" = "quick" ]; then
   run_serve_subset_quick
   run_overload_subset_quick
   run_fleet_subset_quick
+  run_dist_subset_quick
   run_context_subset
   run_elastic_subset_quick
   run_wire_subset_quick
@@ -195,6 +217,7 @@ run_ft_subset
 run_serve_subset_full
 run_overload_subset_full
 run_fleet_subset_full
+run_dist_subset_full
 run_context_subset
 run_elastic_subset_full
 run_wire_subset_full

@@ -50,7 +50,8 @@ def _client(args):
     if args.port is not None:
         return ServeClient.local(args.port, retries=args.retries,
                                  token=token)
-    state = args.state or os.environ.get("MRTPU_SERVE_STATE")
+    from gpu_mapreduce_tpu.utils.env import env_str
+    state = args.state or env_str("MRTPU_SERVE_STATE", None)
     if not state:
         print("need --port or --state (or MRTPU_SERVE_STATE)",
               file=sys.stderr)

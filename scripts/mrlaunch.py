@@ -1,0 +1,672 @@
+#!/usr/bin/env python
+"""mrlaunch — the multi-process data plane's supervisor.
+
+Launches N worker processes that form one process-spanning mesh
+(``jax.distributed`` coordinator bootstrap, gloo cross-process CPU
+collectives, 1 forced host-platform device per process — the
+multi-controller code path a TPU pod uses), runs a chunked, checkpointed
+workload over the existing collective shuffle machinery, and SURVIVES
+rank death: when a rank is SIGKILLed or hangs, the survivors' collective
+watchdog (parallel/dist.py) converts the stall into a bounded
+``PeerLostError`` exit, and this launcher fences the dead rank, shrinks
+the world to the largest power of two ≤ survivors, and relaunches a
+fresh generation that resumes from the last durable checkpoint manifest
+— output byte-identical to an uninterrupted run at the narrow width
+(tests/test_dist.py pins exactly that golden).
+
+Why relaunch instead of re-forming in place: a failed generation's gloo
+contexts hold TCP peers that no longer exist and jax's coordination
+service lives inside rank 0 — neither survives a member's death.  Fresh
+processes on a fresh coordinator port, restored from durable state, is
+the honest (and the production: think job-manager restarts a pod slice)
+recovery path; the fence files make the old generation's zombies
+harmless in the meantime.
+
+Usage::
+
+    python scripts/mrlaunch.py --np 4 --rundir /tmp/run \\
+        wordfreq --files a.txt b.txt --out /tmp/run/out.txt \\
+        --chunks 8 --ckpt-every 1
+
+Chaos (deterministic, via ft/inject's process-level kinds)::
+
+    MRTPU_FAULTS='site=dist.exchange;kind=peer_kill;rank=2;after=1;n=1' \\
+        python scripts/mrlaunch.py --np 4 ...
+
+Exit codes from workers: 0 = done, 75 = survivor that detected a peer
+loss (EXIT_PEER_LOST), 76 = fenced zombie that declined to act
+(EXIT_FENCED).  Anything else — and any signal death — marks the rank
+dead.  The launcher prints one summary JSON line (``mrlaunch:``) with
+generations, dead ranks and ``recover_seconds`` (first fault detection
+→ the shrunk generation's data plane fully heartbeating).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_WORKLOAD_SPEC = "workload.json"
+
+
+# ---------------------------------------------------------------------------
+# shared helpers (launcher + worker)
+# ---------------------------------------------------------------------------
+
+def _pick_port() -> int:
+    s = socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _ckpt_root(rundir: str) -> str:
+    return os.path.join(rundir, "ckpt")
+
+
+def _step_dir(rundir: str, step: int) -> str:
+    return os.path.join(_ckpt_root(rundir), f"step-{step:05d}")
+
+
+def _manifest_path(step_dir: str) -> str:
+    return os.path.join(step_dir, "MANIFEST.json")
+
+
+def _sha256(path: str) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def latest_manifest(rundir: str):
+    """(manifest dict, step dir) of the newest VALID checkpoint: every
+    shard file must exist and match its recorded digest — a torn or
+    half-written generation falls back to the previous one, exactly
+    like ft.plan_resume's generation fallback."""
+    root = _ckpt_root(rundir)
+    try:
+        steps = sorted(d for d in os.listdir(root) if d.startswith("step-"))
+    except OSError:
+        return None, None
+    from gpu_mapreduce_tpu.utils.fsio import read_json
+    for d in reversed(steps):
+        sdir = os.path.join(root, d)
+        man = read_json(_manifest_path(sdir))
+        if not man or "shards" not in man:
+            continue
+        ok = True
+        for meta in man["shards"].values():
+            path = os.path.join(sdir, meta["file"])
+            if not os.path.exists(path) or _sha256(path) != meta["sha256"]:
+                ok = False
+                break
+        if ok:
+            return man, sdir
+        print(f"mrlaunch: checkpoint {d} damaged/incomplete; "
+              f"falling back", file=sys.stderr)
+    return None, None
+
+
+def _atomic_npz(path: str, **arrays) -> None:
+    """Durable npz: tmp + fsync + rename + dir fsync (utils/fsio)."""
+    import numpy as np
+
+    from gpu_mapreduce_tpu.utils.fsio import atomic_replace
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    atomic_replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# the worker: one rank of the data plane
+# ---------------------------------------------------------------------------
+
+def _stable_ids(words):
+    """bytes word → u64 id via blake2b-8: content-deterministic across
+    processes and runs (Python's hash() is salted; intern tables are
+    per-process) — the property the whole golden rests on."""
+    import hashlib
+
+    import numpy as np
+    cache = {}
+    out = np.empty(len(words), np.uint64)
+    for i, w in enumerate(words):
+        v = cache.get(w)
+        if v is None:
+            v = cache[w] = int.from_bytes(
+                hashlib.blake2b(w, digest_size=8).digest(), "little")
+        out[i] = v
+    return out
+
+
+def _even_counts(n: int, m: int):
+    import numpy as np
+    per = -(-n // m) if n else 0
+    starts = np.minimum(np.arange(m) * per, n)
+    return (np.minimum(starts + per, n) - starts).astype(np.int64)
+
+
+def _merge_table(tk, tc, nk, nc):
+    """Accumulate (nk, nc) pairs into the sorted (tk, tc) table —
+    np.unique keeps the table sorted, np.add.at keeps sums exact."""
+    import numpy as np
+    allk = np.concatenate([tk, nk])
+    allc = np.concatenate([tc, nc])
+    uk, inv = np.unique(allk, return_inverse=True)
+    sums = np.zeros(uk.shape[0], np.int64)
+    np.add.at(sums, inv, allc)
+    return uk, sums
+
+
+class _Worker:
+    """One rank's run of the chunked wordfreq pipeline."""
+
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.rundir = spec["rundir"]
+        from gpu_mapreduce_tpu.parallel import dist as D
+        self.D = D
+        self.rt = D.init_from_env()
+        if self.rt is None:
+            raise SystemExit("mrlaunch worker started without "
+                             "MRTPU_DIST_* env — use the launcher")
+        import jax
+
+        import numpy as np
+        from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+        self.np = np
+        self.jax = jax
+        self.mesh = make_mesh()
+        self.W = self.rt.world
+        self.rank = self.rt.rank
+        assert len(jax.devices()) == self.W, \
+            f"{len(jax.devices())} global devices for world {self.W}"
+
+    # -- collective plumbing ------------------------------------------------
+    def _sharded_kv(self, keys, vals, counts):
+        from gpu_mapreduce_tpu.parallel.sharded import ShardedKV
+        garr_k, _ = self.D.shard_local_rows(self.mesh, [keys], counts)
+        garr_v, _ = self.D.shard_local_rows(self.mesh, [vals], counts)
+        return ShardedKV(self.mesh, garr_k, garr_v,
+                         counts.astype(self.np.int32))
+
+    def _pull_my_shard(self, skv, site: str):
+        fr = self.rt.guard(site, skv.shard_to_host, self.rank)
+        return (self.np.asarray(fr.key.data, dtype=self.np.uint64),
+                self.np.asarray(fr.value.data, dtype=self.np.int64))
+
+    def _allgather_sizes(self, n_local: int):
+        """Every rank's table size, via one tiny collective pull — the
+        schedule input for the range rebalance (each controller only
+        knows its own count)."""
+        import jax
+
+        np = self.np
+        from gpu_mapreduce_tpu.parallel.mesh import row_sharding
+        sharding = row_sharding(self.mesh)
+        shape = (self.W,)
+        dmap = sharding.addressable_devices_indices_map(shape)
+        shards = [jax.device_put(np.asarray([n_local], np.int64), dev)
+                  for dev, _ in dmap.items()]
+        garr = jax.make_array_from_single_device_arrays(
+            shape, sharding, shards)
+        return self.rt.guard(
+            "reshard", lambda: self.D.host_pull(garr).astype(np.int64))
+
+    def _barrier(self, site: str = "ckpt_barrier"):
+        """All-ranks sync point: a psum every rank must enter — the
+        checkpoint commit gate (the manifest may only claim shards that
+        are durable on EVERY rank)."""
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        np = self.np
+        from gpu_mapreduce_tpu.parallel.mesh import row_sharding
+        sharding = row_sharding(self.mesh)
+        dmap = sharding.addressable_devices_indices_map((self.W,))
+        shards = [jax.device_put(np.ones(1, np.int64), dev)
+                  for dev, _ in dmap.items()]
+        garr = jax.make_array_from_single_device_arrays(
+            (self.W,), sharding, shards)
+        axes = tuple(self.mesh.axis_names)
+        f = jax.jit(jax.shard_map(
+            lambda x: lax.psum(x, axes if len(axes) > 1 else axes[0]),
+            mesh=self.mesh, in_specs=P(*axes), out_specs=P()))
+
+        def _run():
+            return int(self.D.host_pull(f(garr))[0])
+        got = self.rt.guard(site, _run)
+        assert got == self.W, f"barrier psum {got} != world {self.W}"
+
+    # -- checkpointing ------------------------------------------------------
+    def _checkpoint(self, step: int, tk, tc, chunks_done: int):
+        sdir = _step_dir(self.rundir, step)
+        os.makedirs(sdir, exist_ok=True)
+        fname = f"rank{self.rank}.npz"
+        _atomic_npz(os.path.join(sdir, fname), k=tk, c=tc)
+        self._barrier("ckpt_barrier")
+        if self.rank == 0:
+            shards = {}
+            for r in range(self.W):
+                f = f"rank{r}.npz"
+                path = os.path.join(sdir, f)
+                with self.np.load(path) as z:
+                    nrows = int(z["k"].shape[0])
+                shards[str(r)] = {"file": f, "nrows": nrows,
+                                  "sha256": _sha256(path)}
+            from gpu_mapreduce_tpu.utils.fsio import atomic_write_json
+            atomic_write_json(_manifest_path(sdir), {
+                "step": step, "width": self.W,
+                "chunks_done": chunks_done, "gen": self.rt.gen,
+                "shards": shards})
+            self._gc_ckpts(keep=2)
+
+    def _gc_ckpts(self, keep: int):
+        import shutil
+        root = _ckpt_root(self.rundir)
+        try:
+            steps = sorted(d for d in os.listdir(root)
+                           if d.startswith("step-"))
+        except OSError:
+            return
+        done = [d for d in steps
+                if os.path.exists(_manifest_path(os.path.join(root, d)))]
+        for d in done[:-keep]:
+            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
+
+    # -- restore ------------------------------------------------------------
+    def _restore(self):
+        """(table_k, table_c, chunks_done): re-key the last durable
+        manifest's shards onto THIS generation's (narrower) mesh via
+        the same collective hash exchange the live path uses — the
+        checkpoint is topology-portable because the shards are host
+        frames and the partition is re-derived, never trusted."""
+        np = self.np
+        man, sdir = latest_manifest(self.rundir)
+        if man is None:
+            return (np.zeros(0, np.uint64), np.zeros(0, np.int64), 0)
+        old_w = int(man["width"])
+        nrows = {int(r): int(meta["nrows"])
+                 for r, meta in man["shards"].items()}
+        # old rank r's shard is re-read by new rank (r % W): a
+        # deterministic assignment every controller derives alone
+        counts = np.zeros(self.W, np.int64)
+        for r in range(old_w):
+            counts[r % self.W] += nrows[r]
+        ks, cs = [], []
+        for r in range(old_w):
+            if r % self.W != self.rank:
+                continue
+            with np.load(os.path.join(
+                    sdir, man["shards"][str(r)]["file"])) as z:
+                ks.append(z["k"].astype(np.uint64))
+                cs.append(z["c"].astype(np.int64))
+        myk = (np.concatenate(ks) if ks else np.zeros(0, np.uint64))
+        myc = (np.concatenate(cs) if cs else np.zeros(0, np.int64))
+        # collective re-key: hash%W over the process-spanning mesh —
+        # counts may collide across old shards (hash%old_w partitions
+        # differ), the merge sums them
+        from gpu_mapreduce_tpu.parallel.shuffle import exchange
+        skv = self._sharded_kv(myk, myc, counts)
+        out = exchange(skv, ("hash", None))
+        k, c = self._pull_my_shard(out, "exchange")
+        tk, tc = _merge_table(np.zeros(0, np.uint64),
+                              np.zeros(0, np.int64), k, c)
+        return tk, tc, int(man["chunks_done"])
+
+    # -- the workload -------------------------------------------------------
+    def run_wordfreq(self) -> None:
+        np = self.np
+        spec = self.spec
+        words = []
+        for path in spec["files"]:
+            from gpu_mapreduce_tpu.utils.io import read_words
+            with open(path, "rb") as f:
+                words.extend(read_words(f.read()))
+        ids = _stable_ids(words)
+        C = max(1, int(spec.get("chunks", 4)))
+        ckpt_every = max(1, int(spec.get("ckpt_every", 1)))
+        bounds = np.linspace(0, ids.shape[0], C + 1).astype(np.int64)
+
+        tk, tc, start = self._restore()
+        from gpu_mapreduce_tpu.parallel.shuffle import exchange
+        for c in range(start, C):
+            chunk = ids[bounds[c]:bounds[c + 1]]
+            counts = _even_counts(chunk.shape[0], self.W)
+            offs = np.concatenate([[0], np.cumsum(counts)])
+            mine = chunk[offs[self.rank]:offs[self.rank + 1]]
+            skv = self._sharded_kv(mine.astype(np.uint64),
+                                   np.ones(mine.shape[0], np.int64),
+                                   counts)
+            out = exchange(skv, ("hash", None))
+            k, v = self._pull_my_shard(out, "exchange")
+            tk, tc = _merge_table(tk, tc, k, v)
+            if (c + 1 - start) % ckpt_every == 0 or c == C - 1:
+                self._checkpoint(c + 1, tk, tc, chunks_done=c + 1)
+
+        self._finalize(tk, tc, words)
+
+    def _finalize(self, tk, tc, words) -> None:
+        """Rebalance the hash-partitioned table with the RANGE exchange
+        (the reshard program, unchanged, over the process-spanning
+        mesh), dump per-rank final shards, and let rank 0 decode + emit
+        the deterministic output."""
+        np = self.np
+        sizes = self._allgather_sizes(tk.shape[0])
+        total = int(sizes.sum())
+        offsets = tuple(int(x) for x in
+                        np.concatenate([[0], np.cumsum(sizes)])[:-1])
+        ends = tuple(int(x) for x in
+                     np.cumsum(_even_counts(total, self.W)))
+        from gpu_mapreduce_tpu.parallel.shuffle import exchange
+        skv = self._sharded_kv(tk, tc, sizes)
+        out = exchange(skv, ("range", offsets, ends))
+        k, c = self._pull_my_shard(out, "reshard")
+        fdir = os.path.join(self.rundir, "final")
+        os.makedirs(fdir, exist_ok=True)
+        _atomic_npz(os.path.join(fdir, f"rank{self.rank}.npz"), k=k, c=c)
+        self._barrier("ckpt_barrier")
+        if self.rank == 0:
+            if self.rt.fenced():       # zombie guard on the output write
+                from gpu_mapreduce_tpu.parallel.dist import \
+                    RankFencedError
+                raise RankFencedError(self.rank, "finalize")
+            decode = {}
+            for w in sorted(set(words)):
+                decode.setdefault(int(_stable_ids([w])[0]), w)
+            rows = []
+            for r in range(self.W):
+                with np.load(os.path.join(fdir, f"rank{r}.npz")) as z:
+                    for kk, cc in zip(z["k"], z["c"]):
+                        word = decode.get(int(kk), b"?")
+                        rows.append((int(cc), word))
+            rows.sort(key=lambda rc: (-rc[0], rc[1]))
+            from gpu_mapreduce_tpu.utils.fsio import atomic_replace
+            out_path = self.spec["out"]
+            tmp = f"{out_path}.{os.getpid()}.tmp"
+            with open(tmp, "wb") as f:
+                for cnt, word in rows:
+                    f.write(word + b" %d\n" % cnt)
+                f.flush()
+                os.fsync(f.fileno())
+            atomic_replace(tmp, out_path)
+
+
+def worker_main(argv) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rundir", required=True)
+    args = ap.parse_args(argv)
+    with open(os.path.join(args.rundir, _WORKLOAD_SPEC)) as f:
+        spec = json.load(f)
+    spec["rundir"] = args.rundir
+
+    from gpu_mapreduce_tpu.parallel.dist import (EXIT_FENCED,
+                                                 EXIT_PEER_LOST,
+                                                 PeerLostError,
+                                                 RankFencedError,
+                                                 write_exit_report)
+    w = _Worker(spec)
+    try:
+        if spec["workload"] == "wordfreq":
+            w.run_wordfreq()
+        else:
+            raise SystemExit(f"unknown workload {spec['workload']!r}")
+    except PeerLostError as e:
+        print(f"mrlaunch worker rank {w.rank}: {e}", file=sys.stderr,
+              flush=True)
+        write_exit_report(w.rundir, w.rank, w.rt.gen, "peer_lost",
+                          dead=e.dead, site=e.site)
+        # os._exit: a wedged gloo context must not stall interpreter
+        # teardown (jax's atexit would try to reach dead peers)
+        os._exit(EXIT_PEER_LOST)
+    except RankFencedError as e:
+        print(f"mrlaunch worker rank {w.rank}: {e}", file=sys.stderr,
+              flush=True)
+        write_exit_report(w.rundir, w.rank, w.rt.gen, "fenced")
+        os._exit(EXIT_FENCED)
+    write_exit_report(w.rundir, w.rank, w.rt.gen, "done")
+    w.rt.stop()
+    os._exit(0)
+
+
+# ---------------------------------------------------------------------------
+# the launcher
+# ---------------------------------------------------------------------------
+
+def _spawn_generation(rundir: str, width: int, gen: int):
+    port = _pick_port()
+    procs = {}
+    for rank in range(width):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            # exactly ONE device per process: the worker's slicing,
+            # counts vectors and shard pulls all assume rank ≙ shard
+            # (multi-device-per-process is the fake-mesh tier's job)
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "MRTPU_DIST_WORLD": str(width),
+            "MRTPU_DIST_RANK": str(rank),
+            "MRTPU_DIST_COORD": f"127.0.0.1:{port}",
+            "MRTPU_DIST_RUNDIR": rundir,
+            "MRTPU_DIST_GEN": str(gen),
+        })
+        log = open(os.path.join(rundir, f"g{gen}-rank{rank}.log"), "ab")
+        procs[rank] = (subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--rundir", rundir],
+            env=env, cwd=_REPO, stdout=log, stderr=log), log)
+    return procs
+
+
+def _reap(procs):
+    """{rank: returncode} of exited children (None while running)."""
+    return {r: p.poll() for r, (p, _log) in procs.items()}
+
+
+def _read_exit_reports(rundir: str, gen: int, width: int):
+    from gpu_mapreduce_tpu.parallel.dist import exit_path
+    from gpu_mapreduce_tpu.utils.fsio import read_json
+    out = {}
+    for r in range(width):
+        rec = read_json(exit_path(rundir, r, gen))
+        if rec:
+            out[r] = rec
+    return out
+
+
+def _classify_dead(codes: dict, hung: list, reports: dict) -> set:
+    """Which ranks of a failed generation actually DIED, weighing three
+    evidence tiers.  The subtlety: when any member dies, jax's
+    coordination service (hosted in rank 0) fatal-aborts every
+    remaining client with SIGABRT the moment the service itself goes
+    down — so a -6 exit usually means 'survivor torn down by the
+    cascade', NOT 'dead rank'.
+
+    1. exit reports — a rank that wrote one ran the exit protocol (it
+       is a survivor); the dead lists in peer_lost reports are direct
+       watchdog observations.
+    2. hard evidence — SIGKILL (-9), other signals, unexpected exit
+       codes; plus ranks the launcher itself had to SIGKILL (hung).
+    3. SIGABRT (-6) — counted dead only when tiers 1-2 produced
+       nothing (a genuine crash-storm)."""
+    import signal as _signal
+    dead = set()
+    for r, rec in reports.items():
+        if rec.get("code") == "peer_lost":
+            dead.update(int(d) for d in rec.get("dead", []))
+    dead.update(hung)
+    abrt = set()
+    for r, rc in codes.items():
+        if r in reports or rc in (0, 75, 76) or rc is None:
+            continue
+        if rc == -_signal.SIGABRT:
+            abrt.add(r)
+        else:
+            dead.add(r)
+    if not dead:
+        dead = abrt
+    return dead - set(reports)
+
+
+def run_launcher(args, workload_spec: dict) -> dict:
+    from gpu_mapreduce_tpu.parallel.dist import (EXIT_FENCED,
+                                                 EXIT_PEER_LOST,
+                                                 fence_rank, hb_path,
+                                                 shrink_width)
+    rundir = os.path.abspath(args.rundir)
+    os.makedirs(rundir, exist_ok=True)
+    with open(os.path.join(rundir, _WORKLOAD_SPEC), "w") as f:
+        json.dump(workload_spec, f)
+
+    grace = args.grace
+    width, gen = args.np, 0
+    t_start = time.monotonic()
+    t_detect = None
+    recover_s = None
+    history = []
+
+    while True:
+        procs = _spawn_generation(rundir, width, gen)
+        if t_detect is not None and recover_s is None:
+            # recovery clock: first fault observation → every rank of
+            # the shrunk generation heartbeating (data plane re-formed)
+            deadline = time.monotonic() + grace + 60
+            while time.monotonic() < deadline:
+                if all(os.path.exists(hb_path(rundir, r, gen))
+                       for r in range(width)):
+                    recover_s = time.monotonic() - t_detect
+                    break
+                if any(rc is not None and rc != 0
+                       for rc in _reap(procs).values()):
+                    break
+                time.sleep(0.05)
+        fault = False
+        while True:
+            codes = _reap(procs)
+            abnormal = {r: rc for r, rc in codes.items()
+                        if rc is not None
+                        and rc not in (0, EXIT_PEER_LOST, EXIT_FENCED)}
+            reported = {r for r, rc in codes.items()
+                        if rc == EXIT_PEER_LOST}
+            if abnormal or reported:
+                fault = True
+                if t_detect is None:
+                    t_detect = time.monotonic()
+                break
+            if all(rc is not None for rc in codes.values()):
+                break                       # all exited, none faulted
+            time.sleep(0.05)
+        if not fault:
+            for _p, log in procs.values():
+                log.close()
+            if not all(rc == 0 for rc in codes.values()):
+                # only EXIT_FENCED exits without any fault signal: a
+                # zombie from THIS generation means the fencing logic
+                # broke — fail loudly, never retry into it
+                raise SystemExit(f"mrlaunch: generation {gen} exited "
+                                 f"{codes} with no fault reported")
+            break
+
+        # fault path: give survivors `grace` to trip their watchdogs
+        # and exit, then SIGKILL whatever is left (hung ranks)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if all(rc is not None for rc in _reap(procs).values()):
+                break
+            time.sleep(0.1)
+        hung = []
+        for r, (p, _log) in procs.items():
+            if p.poll() is None:
+                hung.append(r)
+                try:
+                    p.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+                p.wait()
+        codes = _reap(procs)
+        for _p, log in procs.values():
+            log.close()
+        reports = _read_exit_reports(rundir, gen, width)
+        dead = {r for r in _classify_dead(codes, hung, reports)
+                if 0 <= r < width}
+        for r in sorted(dead):
+            fence_rank(rundir, r, by="launcher", gen=gen)
+        survivors = width - len(dead)
+        new_width = shrink_width(survivors)
+        history.append({"gen": gen, "width": width,
+                        "dead": sorted(dead), "codes": codes})
+        print(f"mrlaunch: gen {gen} lost rank(s) {sorted(dead)} "
+              f"(codes {codes}); shrinking {width} -> {new_width}",
+              file=sys.stderr, flush=True)
+        if new_width < 1:
+            raise SystemExit("mrlaunch: no survivors to shrink onto")
+        if gen + 1 > args.max_generations:
+            raise SystemExit(f"mrlaunch: gave up after "
+                             f"{args.max_generations} generations")
+        width, gen = new_width, gen + 1
+
+    summary = {"generations": gen + 1, "final_width": width,
+               "history": history,
+               "recover_seconds": recover_s,
+               "wall_seconds": time.monotonic() - t_start}
+    print("mrlaunch: " + json.dumps(summary), flush=True)
+    from gpu_mapreduce_tpu.utils.fsio import atomic_write_json
+    atomic_write_json(os.path.join(rundir, "launch.json"), summary)
+    return summary
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "--worker":
+        return worker_main(argv[1:])
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--np", type=int, default=2,
+                    help="process count (= mesh width; 1 device/proc)")
+    ap.add_argument("--rundir", required=True,
+                    help="run directory: heartbeats, checkpoints, logs")
+    ap.add_argument("--grace", type=float, default=None,
+                    help="seconds to let survivors trip their watchdog "
+                         "before SIGKILLing stragglers (default: "
+                         "MRTPU_DIST_SYNC_TIMEOUT + 10)")
+    ap.add_argument("--max-generations", type=int, default=3)
+    sub = ap.add_subparsers(dest="workload", required=True)
+    wf = sub.add_parser("wordfreq", help="chunked checkpointed wordfreq")
+    wf.add_argument("--files", nargs="+", required=True)
+    wf.add_argument("--out", required=True)
+    wf.add_argument("--chunks", type=int, default=4)
+    wf.add_argument("--ckpt-every", type=int, default=1)
+    args = ap.parse_args(argv)
+    if args.grace is None:
+        from gpu_mapreduce_tpu.utils.env import env_knob
+        args.grace = env_knob("MRTPU_DIST_SYNC_TIMEOUT", float, 60.0) + 10
+    # absolutize against the LAUNCHER's cwd: workers run with cwd=repo
+    # (so the package resolves), which would silently re-root relative
+    # corpus/output paths
+    spec = {"workload": "wordfreq",
+            "files": [os.path.abspath(f) for f in args.files],
+            "out": os.path.abspath(args.out),
+            "chunks": args.chunks, "ckpt_every": args.ckpt_every}
+    run_launcher(args, spec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

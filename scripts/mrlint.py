@@ -29,8 +29,11 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LINT_DIR = os.path.join(REPO, "gpu_mapreduce_tpu", "lint")
 
-# harness scripts the knob-registry rule scans on top of the package
-EXTRA_FILES = ("soak.py", "bench.py", "weakscale.py")
+# harness scripts the knob-registry and net-timeout rules scan on top
+# of the package (mrctl/mrlaunch are the client and the data-plane
+# supervisor — both daemon-adjacent enough to hold the timeout line)
+EXTRA_FILES = ("soak.py", "bench.py", "weakscale.py",
+               "scripts/mrctl.py", "scripts/mrlaunch.py")
 
 
 def _load_lint():

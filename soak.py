@@ -13,7 +13,12 @@ framework surface:
   the BASELINE.json north-star metric (the reference's pagerank is a
   stub, oink/pagerank.cpp:53-55, so this races no reference number)
 
-Usage:  python soak.py [--metrics-every N] [--chaos SEED]
+Usage:  python soak.py [--metrics-every N] [--chaos SEED] [dist]
+        (`soak.py dist` runs ONLY the multi-process shrink-and-resume
+        soak: a 4-process mrlaunch wordfreq with one rank SIGKILLed
+        mid-run, asserting byte-identical output vs an uninterrupted
+        2-process run and publishing dist_recover_seconds —
+        doc/distributed.md)
         (scale from SOAK_SCALE, default 18; N also via
         SOAK_METRICS_EVERY — print a live metrics snapshot line after
         every N workloads and write a final full-registry snapshot to
@@ -998,6 +1003,66 @@ def main():
                             p.kill()
                             p.wait()
 
+    def do_dist():
+        # multi-process data plane soak (doc/distributed.md): a real
+        # 4-process mrlaunch wordfreq with rank 2 SIGKILLed mid-run —
+        # the launcher must shrink to width 2, resume from the last
+        # durable checkpoint, and produce output byte-identical to an
+        # uninterrupted 2-process run; publishes the recovery clock
+        import random
+        import subprocess
+        import tempfile
+        repo = os.path.dirname(os.path.abspath(__file__))
+        mrl = os.path.join(repo, "scripts", "mrlaunch.py")
+        with tempfile.TemporaryDirectory(prefix="soak-dist-") as td:
+            corpus = os.path.join(td, "corpus.txt")
+            rng5 = random.Random(29)
+            vocab = [f"soak{i:04d}".encode() for i in range(400)]
+            with open(corpus, "wb") as f:
+                for _ in range(20000):
+                    f.write(rng5.choice(vocab))
+                    f.write(b" " if rng5.random() < 0.85 else b"\n")
+
+            def launch(nproc, tag, extra_env):
+                out = os.path.join(td, f"out-{tag}.txt")
+                env = dict(os.environ)
+                env.pop("MRTPU_FAULTS", None)
+                env.update(extra_env)
+                r = subprocess.run(
+                    [sys.executable, mrl, "--np", str(nproc),
+                     "--rundir", os.path.join(td, f"run-{tag}"),
+                     "wordfreq", "--files", corpus, "--out", out,
+                     "--chunks", "8"],
+                    env=env, cwd=repo, capture_output=True,
+                    timeout=600)
+                if r.returncode != 0:
+                    raise RuntimeError(
+                        f"mrlaunch {tag} rc={r.returncode}: "
+                        f"{r.stderr.decode()[-500:]}")
+                summary = json.loads(r.stdout.decode().split(
+                    "mrlaunch: ", 1)[1].splitlines()[0])
+                with open(out, "rb") as f:
+                    return f.read(), summary
+
+            ref, _ = launch(2, "ref", {})
+            got, summary = launch(4, "chaos", {
+                "MRTPU_FAULTS": "site=dist.exchange;kind=peer_kill;"
+                                "rank=2;after=1;n=1",
+                "MRTPU_DIST_SYNC_TIMEOUT": "20"})
+            if got != ref:
+                raise RuntimeError(
+                    "dist shrink-and-resume output differs from the "
+                    "uninterrupted narrow run")
+            if summary["final_width"] != 2:
+                raise RuntimeError(f"expected shrink to 2, got "
+                                   f"{summary['final_width']}")
+            published["dist_ok"] = 1
+            published["dist_recover_seconds"] = round(
+                float(summary["recover_seconds"]), 3)
+            published["dist_generations"] = int(summary["generations"])
+            print(f"soak dist: shrink 4->2 ok, recover "
+                  f"{published['dist_recover_seconds']}s")
+
     workloads = [("degree", do_degree), ("cc_find", do_cc),
                  ("sssp", do_sssp), ("luby", do_luby), ("tri", do_tri),
                  ("external", do_external),
@@ -1022,6 +1087,12 @@ def main():
         # `soak.py overload`: ONLY the shed-the-greedy-tenant soak
         # (doc/serve.md#slo-burn-shedding)
         workloads = [("overload", do_overload)]
+        serve_only = True       # partial publish: merge, don't erase
+    if "dist" in sys.argv[1:]:
+        # `soak.py dist`: ONLY the multi-process shrink-and-resume
+        # soak — kills one rank mid-run, publishes the recovery clock
+        # (doc/distributed.md)
+        workloads = [("dist", do_dist)]
         serve_only = True       # partial publish: merge, don't erase
     for i, (name, fn) in enumerate(workloads, 1):
         guard(name, fn)

@@ -1,0 +1,478 @@
+"""Multi-process data plane (parallel/dist.py + scripts/mrlaunch.py).
+
+Fast tier: heartbeat/fence/watchdog mechanics with fake peers (no
+subprocesses, no jax.distributed), the launcher's dead-rank evidence
+rules, the durable-write helpers, and the process-level fault kinds.
+
+Slow tier (``-m slow``, run by ``scripts/ci.sh dist``): real
+multi-process goldens — N CPU processes over ``jax.distributed`` + gloo
+running the collective wordfreq pipeline, including THE chaos golden: a
+4-process run with rank 2 SIGKILLed mid-job must detect the loss in
+bounded time, shrink to width 2, resume from the last durable
+checkpoint, and produce output byte-identical to an uninterrupted
+2-process run.
+"""
+
+import collections
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gpu_mapreduce_tpu.parallel import dist as D
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MRLAUNCH = os.path.join(REPO, "scripts", "mrlaunch.py")
+
+
+def _load_mrlaunch():
+    spec = importlib.util.spec_from_file_location("_mrlaunch_t", MRLAUNCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# shrink policy
+# ---------------------------------------------------------------------------
+
+def test_shrink_width_largest_pow2():
+    assert D.shrink_width(4) == 4
+    assert D.shrink_width(3) == 2
+    assert D.shrink_width(2) == 2
+    assert D.shrink_width(1) == 1
+    assert D.shrink_width(0) == 0
+    assert D.shrink_width(7) == 4
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + fences
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_roundtrip_and_expiry(tmp_path):
+    run = str(tmp_path)
+    os.makedirs(D.hb_dir(run, 0), exist_ok=True)
+    D.write_beat(run, 3, lease_s=30.0, gen=0, seq=7)
+    beat = D.read_beat(run, 3)
+    assert beat["rank"] == 3 and beat["seq"] == 7
+    assert not D.beat_expired(beat, skew_s=0.5)
+    # expiry is expires + skew, judged against the caller's clock
+    assert D.beat_expired(beat, skew_s=0.5,
+                          now=time.time() + 31.0)
+    # missing or unreadable protects nobody
+    assert D.beat_expired(None, skew_s=0.5)
+    assert D.beat_expired({"junk": 1}, skew_s=0.5)
+
+
+def test_fence_is_exclusive_and_gen_scoped(tmp_path):
+    run = str(tmp_path)
+    assert D.fence_rank(run, 2, by="launcher", gen=0) is True
+    assert D.fence_rank(run, 2, by="other", gen=0) is False  # lost race
+    assert D.is_fenced(run, 2, gen=0)
+    # a fence for gen 0's rank 2 must NOT fence gen 1's rank 2
+    assert not D.is_fenced(run, 2, gen=1)
+
+
+def test_heartbeat_thread_latches_fence(tmp_path):
+    run = str(tmp_path)
+    hb = D.Heartbeat(run, 1, heartbeat_s=0.02, lease_s=1.0)
+    hb.start()
+    try:
+        assert D.read_beat(run, 1) is not None
+        assert not hb.fenced
+        D.fence_rank(run, 1, by="test", gen=0)
+        deadline = time.time() + 2.0
+        while not hb.fenced and time.time() < deadline:
+            time.sleep(0.01)
+        assert hb.fenced
+    finally:
+        hb.stop()
+    assert D.read_beat(run, 1) is None      # clean leave drops the lease
+
+
+# ---------------------------------------------------------------------------
+# the collective watchdog
+# ---------------------------------------------------------------------------
+
+def _runtime(tmp_path, world=2, rank=0, **kw):
+    kw.setdefault("heartbeat_s", 0.02)
+    kw.setdefault("lease_s", 0.2)
+    kw.setdefault("skew_s", 0.05)
+    kw.setdefault("sync_timeout_s", 30.0)
+    return D.DistRuntime(rank, world, str(tmp_path), **kw)
+
+
+def test_guard_passthrough_result(tmp_path):
+    rt = _runtime(tmp_path)
+    D.write_beat(str(tmp_path), 1, lease_s=30.0)
+    assert rt.guard("exchange", lambda a, b: a + b, 2, 3) == 5
+
+
+def test_guard_trips_on_expired_peer_lease(tmp_path):
+    rt = _runtime(tmp_path)
+    # peer 1's lease is already stale: a hung collective must become a
+    # bounded PeerLostError, not an infinite stall
+    D.write_beat(str(tmp_path), 1, lease_s=0.01)
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    with pytest.raises(D.PeerLostError) as ei:
+        rt.guard("exchange", time.sleep, 60)
+    assert time.monotonic() - t0 < 5.0
+    assert ei.value.dead == [1]
+    assert ei.value.site == "exchange"
+
+
+def test_guard_trips_on_sync_deadline_with_live_peer(tmp_path):
+    # the hung-but-heartbeating case: the peer's lease stays fresh, so
+    # only the sync deadline can catch it
+    rt = _runtime(tmp_path, sync_timeout_s=0.3)
+    D.write_beat(str(tmp_path), 1, lease_s=60.0)
+    t0 = time.monotonic()
+    with pytest.raises(D.PeerLostError) as ei:
+        rt.guard("count_sync", time.sleep, 60)
+    assert 0.2 < time.monotonic() - t0 < 5.0
+    assert "deadline" in str(ei.value)
+
+
+def test_guard_raises_fenced_for_zombie(tmp_path):
+    rt = _runtime(tmp_path)
+    D.write_beat(str(tmp_path), 1, lease_s=60.0)
+    D.fence_rank(str(tmp_path), 0, by="launcher", gen=0)
+    with pytest.raises(D.RankFencedError):
+        rt.guard("ckpt_barrier", lambda: 1)
+
+
+def test_guard_converts_transport_error_when_peer_died(tmp_path):
+    rt = _runtime(tmp_path)
+    D.write_beat(str(tmp_path), 1, lease_s=0.15)
+
+    def fail():
+        raise RuntimeError("connection reset by peer")
+    # the transport sees the death before the lease expires; the guard
+    # confirms against the lease within one expiry window and converts
+    with pytest.raises(D.PeerLostError):
+        rt.guard("exchange", fail)
+
+
+def test_guard_reraises_original_error_with_healthy_peers(tmp_path):
+    rt = _runtime(tmp_path)
+    D.write_beat(str(tmp_path), 1, lease_s=60.0)
+
+    def fail():
+        raise ValueError("a real bug, not a dead peer")
+    with pytest.raises(ValueError):
+        rt.guard("exchange", fail)
+
+
+def test_guard_call_without_runtime_is_direct(tmp_path):
+    assert D.active() is None
+    assert D.guard_call("exchange", lambda: 42) == 42
+
+
+def test_exit_report_roundtrip(tmp_path):
+    run = str(tmp_path)
+    os.makedirs(D.hb_dir(run, 1), exist_ok=True)
+    D.write_exit_report(run, 0, 1, "peer_lost", dead=[2], site="exchange")
+    from gpu_mapreduce_tpu.utils.fsio import read_json
+    rec = read_json(D.exit_path(run, 0, 1))
+    assert rec["code"] == "peer_lost" and rec["dead"] == [2]
+
+
+# ---------------------------------------------------------------------------
+# process-level fault kinds (ft/inject)
+# ---------------------------------------------------------------------------
+
+def test_peer_kill_spec_parses_with_rank_selector():
+    from gpu_mapreduce_tpu.ft import inject
+    specs = inject.parse_faults(
+        "site=dist.exchange;kind=peer_kill;rank=2;after=1;n=1")
+    (s,) = specs
+    assert s.kind == "peer_kill" and s.rank == 2 and s.after == 1
+
+
+def test_peer_kinds_rejected_outside_dist_sites():
+    from gpu_mapreduce_tpu.ft import inject
+    with pytest.raises(ValueError):
+        inject.FaultSpec(site="spill.write", kind="peer_kill")
+    with pytest.raises(ValueError):
+        inject.FaultSpec(site="*", kind="peer_hang")
+
+
+def test_rank_selector_filters_other_ranks(monkeypatch):
+    from gpu_mapreduce_tpu.ft import inject
+    monkeypatch.setattr(inject, "_RANK", 1)
+    spec = inject.FaultSpec(site="dist.exchange", kind="peer_hang",
+                            rank=2)
+    assert not spec.matches("dist.exchange")
+    monkeypatch.setattr(inject, "_RANK", 2)
+    assert spec.matches("dist.exchange")
+
+
+def test_peer_hang_sleeps_bounded(monkeypatch):
+    from gpu_mapreduce_tpu.ft import inject
+    monkeypatch.setenv("MRTPU_DIST_HANG_S", "0.05")
+    inject.clear_faults()
+    inject.schedule(site="dist.count_sync", kind="peer_hang",
+                    max_faults=1)
+    try:
+        t0 = time.monotonic()
+        inject.fault_point("dist.count_sync")   # sleeps, then returns
+        assert time.monotonic() - t0 >= 0.04
+    finally:
+        inject.clear_faults()
+
+
+# ---------------------------------------------------------------------------
+# durable writes (utils/fsio — the satellite durability fix)
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_json_fsyncs_parent_dir(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.utils import fsio
+    synced = []
+    real_fsync = os.fsync
+
+    def spy(fd):
+        try:
+            import stat
+            if stat.S_ISDIR(os.fstat(fd).st_mode):
+                synced.append(fd)
+        except OSError:
+            pass
+        return real_fsync(fd)
+    monkeypatch.setattr(os, "fsync", spy)
+    path = str(tmp_path / "x.json")
+    fsio.atomic_write_json(path, {"a": 1})
+    assert synced, "parent directory was not fsynced after the rename"
+    assert fsio.read_json(path) == {"a": 1}
+
+
+def test_spill_atomic_save_fsyncs_parent_dir(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.exec import spill
+    from gpu_mapreduce_tpu.utils import fsio
+    dirs = []
+    real = fsio.fsync_dir
+    monkeypatch.setattr(fsio, "fsync_dir",
+                        lambda p: (dirs.append(p), real(p)))
+    path = str(tmp_path / "run.npy")
+    spill.atomic_save(path, np.arange(10))
+    assert dirs and os.path.realpath(dirs[0]) == \
+        os.path.realpath(str(tmp_path))
+    assert np.array_equal(np.load(path), np.arange(10))
+
+
+def test_journal_creation_fsyncs_dir(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.ft.journal import Journal
+    from gpu_mapreduce_tpu.utils import fsio
+    dirs = []
+    real = fsio.fsync_dir
+    monkeypatch.setattr(fsio, "fsync_dir",
+                        lambda p: (dirs.append(p), real(p)))
+    j = Journal(str(tmp_path / "jd"))
+    j.close()
+    assert any(d.endswith("jd") for d in dirs)
+
+
+# ---------------------------------------------------------------------------
+# multi-controller helpers on the single-process fake mesh
+# ---------------------------------------------------------------------------
+
+def test_host_pull_matches_asarray_single_process():
+    import jax.numpy as jnp
+    arr = jnp.arange(12)
+    assert np.array_equal(D.host_pull(arr), np.arange(12))
+
+
+def test_shard_local_rows_single_controller():
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(2)
+    counts = np.array([3, 2], np.int64)
+    blocks = [np.array([1, 2, 3], np.uint64),
+              np.array([4, 5], np.uint64)]
+    garr, cap = D.shard_local_rows(mesh, blocks, counts)
+    assert cap == 8 and garr.shape == (16,)
+    host = np.asarray(garr)
+    assert list(host[:3]) == [1, 2, 3]
+    assert list(host[cap:cap + 2]) == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# launcher units
+# ---------------------------------------------------------------------------
+
+def test_classify_dead_trusts_exit_reports_over_sigabrt():
+    m = _load_mrlaunch()
+    # rank 2 SIGKILLed; rank 0 reported dead=[2]; ranks 1,3 torn down
+    # by the coordination-service cascade (SIGABRT) — survivors
+    codes = {0: 75, 1: -6, 2: -9, 3: -6}
+    reports = {0: {"code": "peer_lost", "dead": [2]}}
+    assert m._classify_dead(codes, [], reports) == {2}
+
+
+def test_classify_dead_sigkill_is_always_dead():
+    m = _load_mrlaunch()
+    codes = {0: 75, 1: -9, 2: 75, 3: -6}
+    reports = {0: {"code": "peer_lost", "dead": []},
+               2: {"code": "peer_lost", "dead": []}}
+    # -9 is hard evidence; once hard evidence exists, rank 3's SIGABRT
+    # is read as the coordination-service cascade, not a death
+    assert m._classify_dead(codes, [], reports) == {1}
+
+
+def test_classify_dead_abrt_only_when_no_other_evidence():
+    m = _load_mrlaunch()
+    codes = {0: -6, 1: -6}
+    assert m._classify_dead(codes, [], {}) == {0, 1}
+
+
+def test_classify_dead_hung_ranks_count():
+    m = _load_mrlaunch()
+    codes = {0: 75, 1: -9}   # 1 was SIGKILLed by the launcher (hung)
+    reports = {0: {"code": "peer_lost", "dead": []}}
+    assert m._classify_dead(codes, [1], reports) == {1}
+
+
+def test_latest_manifest_skips_damaged_generation(tmp_path):
+    m = _load_mrlaunch()
+    run = str(tmp_path)
+    for step, tag in ((1, b"one"), (2, b"two")):
+        sdir = m._step_dir(run, step)
+        os.makedirs(sdir, exist_ok=True)
+        path = os.path.join(sdir, "rank0.npz")
+        with open(path, "wb") as f:
+            f.write(tag)
+        from gpu_mapreduce_tpu.utils.fsio import atomic_write_json
+        atomic_write_json(m._manifest_path(sdir), {
+            "step": step, "width": 1, "chunks_done": step,
+            "shards": {"0": {"file": "rank0.npz", "nrows": 0,
+                             "sha256": m._sha256(path)}}})
+    # damage the newest generation's shard: fallback must pick step 1
+    with open(os.path.join(m._step_dir(run, 2), "rank0.npz"), "wb") as f:
+        f.write(b"corrupt")
+    man, sdir = m.latest_manifest(run)
+    assert man["step"] == 1 and sdir.endswith("step-00001")
+
+
+def test_merge_table_and_stable_ids_deterministic():
+    m = _load_mrlaunch()
+    ids1 = m._stable_ids([b"alpha", b"beta", b"alpha"])
+    ids2 = m._stable_ids([b"alpha", b"beta", b"alpha"])
+    assert np.array_equal(ids1, ids2) and ids1[0] == ids1[2]
+    tk, tc = m._merge_table(np.array([1, 5], np.uint64),
+                            np.array([2, 3], np.int64),
+                            np.array([5, 9], np.uint64),
+                            np.array([1, 7], np.int64))
+    assert list(tk) == [1, 5, 9] and list(tc) == [2, 4, 7]
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real multi-process goldens
+# ---------------------------------------------------------------------------
+
+def _write_corpus(path, nwords=3000, vocab=150, seed=11):
+    import random
+    rng = random.Random(seed)
+    words = [f"w{i:03d}".encode() for i in range(vocab)]
+    with open(path, "wb") as f:
+        for _ in range(nwords):
+            f.write(rng.choice(words))
+            f.write(b" " if rng.random() < 0.85 else b"\n")
+    return path
+
+
+def _expected_output(corpus_paths):
+    """The reference answer, computed serially: counts by word, rows
+    sorted (-count, word) — exactly the worker's output contract."""
+    from gpu_mapreduce_tpu.utils.io import read_words
+    counts = collections.Counter()
+    for p in corpus_paths:
+        with open(p, "rb") as f:
+            counts.update(read_words(f.read()))
+    rows = sorted(counts.items(), key=lambda wc: (-wc[1], wc[0]))
+    return b"".join(w + b" %d\n" % c for w, c in rows)
+
+
+def _mrlaunch(nproc, rundir, corpus, out, chunks=4, env=None,
+              timeout=300, expect_rc=0):
+    e = dict(os.environ)
+    e.pop("MRTPU_FAULTS", None)
+    e.update(env or {})
+    r = subprocess.run(
+        [sys.executable, MRLAUNCH, "--np", str(nproc),
+         "--rundir", rundir, "wordfreq", "--files", corpus,
+         "--out", out, "--chunks", str(chunks)],
+        env=e, cwd=REPO, capture_output=True, timeout=timeout)
+    assert r.returncode == expect_rc, \
+        f"mrlaunch rc={r.returncode}\n{r.stdout.decode()[-2000:]}" \
+        f"\n{r.stderr.decode()[-2000:]}"
+    return r
+
+
+@pytest.mark.slow
+def test_dist_two_process_wordfreq_matches_serial(tmp_path):
+    corpus = _write_corpus(str(tmp_path / "c.txt"))
+    out = str(tmp_path / "out.txt")
+    _mrlaunch(2, str(tmp_path / "run"), corpus, out)
+    with open(out, "rb") as f:
+        assert f.read() == _expected_output([corpus])
+
+
+@pytest.mark.slow
+def test_dist_chaos_golden_peer_kill_shrinks_and_matches(tmp_path):
+    """THE acceptance golden: 4-process run, rank 2 SIGKILLed at its
+    second exchange; survivors detect in bounded time, the launcher
+    shrinks to width 2 and resumes from the last durable checkpoint;
+    the output is byte-identical to an uninterrupted 2-process run."""
+    corpus = _write_corpus(str(tmp_path / "c.txt"))
+    ref = str(tmp_path / "ref.txt")
+    _mrlaunch(2, str(tmp_path / "ref-run"), corpus, ref, chunks=6)
+
+    out = str(tmp_path / "out.txt")
+    t0 = time.monotonic()
+    r = _mrlaunch(4, str(tmp_path / "run"), corpus, out, chunks=6, env={
+        "MRTPU_FAULTS":
+            "site=dist.exchange;kind=peer_kill;rank=2;after=1;n=1",
+        "MRTPU_DIST_SYNC_TIMEOUT": "20",
+    })
+    wall = time.monotonic() - t0
+    with open(out, "rb") as f:
+        got = f.read()
+    with open(ref, "rb") as f:
+        want = f.read()
+    assert got == want, "shrunk-and-resumed output differs from the " \
+                        "uninterrupted narrow run"
+    summary = json.loads(
+        r.stdout.decode().split("mrlaunch: ", 1)[1].splitlines()[0])
+    assert summary["final_width"] == 2
+    assert summary["generations"] == 2
+    assert summary["history"][0]["dead"] == [2]
+    assert summary["recover_seconds"] is not None
+    assert summary["recover_seconds"] < 60.0
+    assert wall < 240.0
+
+
+@pytest.mark.slow
+def test_dist_chaos_golden_peer_hang_trips_watchdog(tmp_path):
+    """A hung (still-heartbeating) rank must trip the survivors' sync
+    deadline instead of stalling the suite; the run then completes at
+    the shrunk width with correct output."""
+    corpus = _write_corpus(str(tmp_path / "c.txt"))
+    out = str(tmp_path / "out.txt")
+    t0 = time.monotonic()
+    r = _mrlaunch(2, str(tmp_path / "run"), corpus, out, chunks=6, env={
+        "MRTPU_FAULTS":
+            "site=dist.count_sync;kind=peer_hang;rank=1;after=2;n=1",
+        "MRTPU_DIST_SYNC_TIMEOUT": "6",
+    }, timeout=300)
+    wall = time.monotonic() - t0
+    with open(out, "rb") as f:
+        assert f.read() == _expected_output([corpus])
+    summary = json.loads(
+        r.stdout.decode().split("mrlaunch: ", 1)[1].splitlines()[0])
+    assert summary["generations"] == 2
+    assert summary["history"][0]["dead"] == [1]
+    assert wall < 240.0, "the hang was not bounded by the watchdog"

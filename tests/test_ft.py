@@ -920,3 +920,77 @@ def test_stats_ft_section_shape():
     ft.set_budget("spill.read", 2)
     st = MapReduce().stats()["ft"]
     assert st["budgets"] == {"spill.read": 2}
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC mid-append (ISSUE 15 satellite): the journal tears, never lies
+# ---------------------------------------------------------------------------
+
+class _EnospcFile:
+    """File wrapper that writes a PARTIAL line then raises ENOSPC on
+    the first record-sized write — the torn-tail shape a full disk
+    actually produces (some bytes land, the rest don't, no newline)."""
+
+    def __init__(self, f, after_writes=0):
+        self._f = f
+        self._skip = after_writes
+        self.fired = False
+
+    def write(self, s):
+        if not self.fired and self._skip == 0:
+            self.fired = True
+            self._f.write(s[: max(3, len(s) // 3)])
+            import errno
+            raise OSError(errno.ENOSPC, "No space left on device")
+        self._skip = max(0, self._skip - 1)
+        return self._f.write(s)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def test_journal_enospc_mid_append_torn_tail_quarantined(tmp_path):
+    """ENOSPC raised mid-``Journal.append``: existing records stay
+    readable past the torn tail, the torn record is NOT half-replayed
+    after restart, and the serve disk monitor latches degraded on the
+    raised error."""
+    from gpu_mapreduce_tpu.ft import journal as J
+    from gpu_mapreduce_tpu.serve.overload import DiskMonitor
+
+    jdir = str(tmp_path / "jd")
+    j = J.Journal(jdir, script_mode=True)
+    j.begin(["cmd a", "cmd b", "cmd c"], "t")
+    j.cmd_done("cmd a")
+
+    j._f = _EnospcFile(j._f)
+    with pytest.raises(OSError) as ei:
+        j.cmd_done("cmd b")
+    assert j._f.fired
+
+    # the serve tier's pressure monitor latches on exactly this error
+    dm = DiskMonitor([jdir], floor_mb=0)
+    assert dm.note_error(ei.value) is True
+    assert dm.degraded and "ENOSPC" in (dm.check() or "")
+
+    j.close()
+
+    # past the torn tail every durable record still reads; the torn
+    # cmd record was never durable, so it must be ABSENT (not merged,
+    # not half-parsed) — records never lead their facts
+    recs = J.read_journal(jdir)
+    kinds = [(r.get("kind"), r.get("seq")) for r in recs]
+    assert ("begin", None) == (recs[0]["kind"], recs[0].get("seq", None))
+    assert ("cmd", 1) in kinds
+    assert ("cmd", 2) not in kinds
+
+    # restart: the reopened journal seals the tear and keeps appending;
+    # the replay plan counts only the durable command
+    j2 = J.Journal(jdir, script_mode=True)
+    j2.cmd_seq = 1
+    j2.cmd_done("cmd b")          # the retry lands cleanly after seal
+    j2.close()
+    recs = J.read_journal(jdir)
+    kinds = [(r.get("kind"), r.get("seq")) for r in recs]
+    assert kinds.count(("cmd", 2)) == 1
+    plan = J.plan_resume(jdir)
+    assert plan["cmds_done"] == 2 and plan["skip"] == 0

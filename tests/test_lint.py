@@ -583,3 +583,79 @@ def test_cli_unknown_rule_exits_2():
         capture_output=True, text=True, timeout=120)
     assert res.returncode == 2
     assert "unknown rule" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# net-timeout
+# ---------------------------------------------------------------------------
+
+NET_BAD = """
+    import socket
+    import urllib.request
+
+    def probe(port):
+        socket.create_connection(("127.0.0.1", port)).close()
+
+    def fetch(url):
+        return urllib.request.urlopen(url).read()
+
+    def legacy(host):
+        import http.client
+        return http.client.HTTPConnection(host, 80)
+"""
+
+NET_CLEAN = """
+    import socket
+    import urllib.request
+
+    def probe(port):
+        socket.create_connection(("127.0.0.1", port),
+                                 timeout=0.5).close()
+
+    def fetch(url):
+        return urllib.request.urlopen(url, timeout=30.0).read()
+
+    def fetch_positional(url):
+        # timeout in its positional slot counts too
+        return urllib.request.urlopen(url, None, 30.0).read()
+
+    def legacy(host):
+        import http.client
+        return http.client.HTTPConnection(host, 80, 10.0)
+
+    def intentional(port):
+        socket.create_connection(("127.0.0.1", port)).close()  # mrlint: disable=net-timeout
+"""
+
+
+def test_net_timeout_true_positive(tmp_path):
+    _, live = run_fixture(str(tmp_path),
+                          {"serve/mod.py": NET_BAD},
+                          rules=["net-timeout"])
+    assert len(live) == 3
+    assert all(f.rule == "net-timeout" for f in live)
+
+
+def test_net_timeout_clean(tmp_path):
+    _, live = run_fixture(str(tmp_path),
+                          {"serve/mod.py": NET_CLEAN},
+                          rules=["net-timeout"])
+    assert live == []
+
+
+def test_net_timeout_out_of_scope_module_ignored(tmp_path):
+    # the rule scopes to serve/ + obs/httpd.py + opted-in extras: a
+    # data-plane module with a raw socket is not this rule's business
+    _, live = run_fixture(str(tmp_path),
+                          {"parallel/mod.py": NET_BAD},
+                          rules=["net-timeout"])
+    assert live == []
+
+
+def test_net_timeout_tree_is_clean():
+    project = lint.Project(REPO, package="gpu_mapreduce_tpu",
+                           extra_files=("scripts/mrctl.py",
+                                        "scripts/mrlaunch.py"))
+    live = [f for f in lint.run(project, rules=["net-timeout"])
+            if not f.suppressed]
+    assert live == [], [str(f) for f in live]
