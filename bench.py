@@ -315,6 +315,8 @@ ELASTIC_MODE = False  # --elastic (or BENCH_ELASTIC=1): reshard wall +
 #                       MRTPU_VERIFY read-overhead advisory rows
 WIRE_MODE = None   # --wire {0,1,ab} (or BENCH_WIRE): compressed-vs-raw
 #                    shuffle exchange A/B on the shuffle-bound workloads
+OBSDIST_MODE = False  # --obsdist (or BENCH_OBSDIST=1): 4-proc mrlaunch
+#                       wordfreq with sync-site instrumentation on vs off
 GATE = False       # --gate: after the run, regress-check against the
 #                    BENCH_r*.json trailing baseline (scripts/
 #                    bench_compare.py) and exit nonzero on a trip
@@ -838,6 +840,58 @@ def elastic_record() -> dict:
     return json.loads(p.stdout.strip().splitlines()[-1])
 
 
+def obsdist_ab_record() -> dict:
+    """``--obsdist``: fleet-observability overhead A/B — the SAME
+    4-process mrlaunch wordfreq run with the dist sync observer /
+    per-rank trace / metrics dumper armed (the default) vs all three
+    disarmed, wall-clock from each run's ``launch.json``.  Recorded
+    into ``detail.obs_dist_ab`` as the advisory
+    ``obs_dist_overhead_pct`` bench_compare row: arrival stamps are
+    one appended JSONL line per sync per rank, so the verdict should
+    sit within run-to-run noise — a drift here means the observer
+    started doing work inside the collective path."""
+    import random
+    mrlaunch = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "mrlaunch.py")
+    tmp = tempfile.mkdtemp(prefix="bench_obsdist_")
+    corpus = os.path.join(tmp, "corpus.txt")
+    rng = random.Random(7)
+    words = [f"w{i:04d}".encode() for i in range(500)]
+    with open(corpus, "wb") as f:
+        for _ in range(60_000):
+            f.write(rng.choice(words))
+            f.write(b" " if rng.random() < 0.85 else b"\n")
+    base = dict(os.environ)
+    base.pop("MRTPU_FAULTS", None)
+    off_env = dict(base)
+    # mrlint: disable=knob-bypass  (subprocess env assembly, not reads)
+    off_env.update({"MRTPU_DIST_TRACE": "0", "MRTPU_DIST_METRICS": "0",
+                    "MRTPU_DIST_SYNC_OBS": "0"})
+    out = {}
+    # off first, then on: a shared-host cache warmup bias would flatter
+    # the instrumented side, which is the conservative direction
+    for tag, env in (("off", off_env), ("on", base)):
+        rundir = os.path.join(tmp, f"run-{tag}")
+        p = subprocess.run(
+            [sys.executable, mrlaunch, "--np", "4", "--rundir", rundir,
+             "wordfreq", "--files", corpus,
+             "--out", os.path.join(tmp, f"out-{tag}.txt"),
+             "--chunks", "4"],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"obsdist {tag} run failed rc={p.returncode}: "
+                f"{p.stderr[-400:]}")
+        with open(os.path.join(rundir, "launch.json")) as f:
+            out[f"{tag}_s"] = round(float(
+                json.load(f)["wall_seconds"]), 4)
+    off, on = out["off_s"], out["on_s"]
+    out["overhead_pct"] = round((on - off) / off * 100.0, 2) if off \
+        else 0.0
+    return out
+
+
 def run_bench(engine, backend_err):
     total_mb = int(os.environ.get("BENCH_MB", "256"))
     skew = os.environ.get("BENCH_SKEW", "0") == "1"
@@ -961,6 +1015,14 @@ def run_bench(engine, backend_err):
         except Exception:
             detail["wire_ab"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if OBSDIST_MODE:
+        # --obsdist: 4-proc mrlaunch instrumentation on/off A/B
+        # (obs/fleetobs.py); failures must not cost the headline
+        try:
+            detail["obs_dist_ab"] = obsdist_ab_record()
+        except Exception:
+            detail["obs_dist_ab"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     if os.environ.get("BENCH_PROFILE_AB", "1") != "0":
         # trace-context armed-vs-disarmed micro A/B (obs/context.py):
         # cheap (~seconds), recorded on every round so the advisory
@@ -991,7 +1053,7 @@ def run_bench(engine, backend_err):
 
 def main():
     global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, ELASTIC_MODE, GATE, \
-        WIRE_MODE
+        WIRE_MODE, OBSDIST_MODE
     argv = sys.argv[1:]
     GATE = "--gate" in argv or os.environ.get("BENCH_GATE") == "1"
     if "--fuse" in argv:
@@ -1020,6 +1082,8 @@ def main():
         os.environ.get("BENCH_SERVE") == "1"
     ELASTIC_MODE = "--elastic" in argv or \
         os.environ.get("BENCH_ELASTIC") == "1"
+    OBSDIST_MODE = "--obsdist" in argv or \
+        os.environ.get("BENCH_OBSDIST") == "1"
     backend_err = None
     try:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))

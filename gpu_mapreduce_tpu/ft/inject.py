@@ -78,9 +78,12 @@ _KINDS = {"oserror": InjectedOSError, "ioerror": InjectedOSError,
 # fault.  peer_kill SIGKILLs self at the drawn probe (the k-th sync of
 # a chaos golden, deterministic via after=/n=); peer_hang sleeps past
 # every watchdog deadline (MRTPU_DIST_HANG_S) so survivors must trip on
-# the sync timeout, not a lease expiry.  Restricted to dist.* sites —
-# killing the process at spill.write would just be a worse `fatal`.
-_PROC_KINDS = ("peer_kill", "peer_hang")
+# the sync timeout, not a lease expiry; delay sleeps MRTPU_DIST_DELAY_S
+# and then PROCEEDS into the collective — a slow host, not a dead one,
+# which is what the straggler-attribution goldens stage.  Restricted to
+# dist.* sites — killing the process at spill.write would just be a
+# worse `fatal`.
+_PROC_KINDS = ("peer_kill", "peer_hang", "delay")
 
 
 class FaultSpec:
@@ -316,6 +319,13 @@ def _proc_fault(kind: str, site: str) -> None:
         import signal as _signal
         _os.kill(_os.getpid(), _signal.SIGKILL)
         return                      # unreachable
+    if kind == "delay":
+        # a slow host, not a dead one: stall short of the watchdog
+        # deadline, then ENTER the collective — every survivor completes
+        # the sync late and the straggler attribution must name us
+        from ..utils.env import env_knob
+        _time.sleep(env_knob("MRTPU_DIST_DELAY_S", float, 2.0))
+        return
     # peer_hang: sleep past every watchdog deadline so survivors must
     # trip on the sync timeout; the sleep happens ON the sync path (the
     # main thread), so our own heartbeat thread keeps beating — the

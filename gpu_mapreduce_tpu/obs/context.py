@@ -92,7 +92,7 @@ class RequestAccount:
                  "exchange_wire_logical",
                  "spill_write", "spill_read",
                  "mem_in_use", "mem_hi_water",
-                 "retries", "plan", "fusion", "stages",
+                 "retries", "plan", "fusion", "stages", "sync_sites",
                  "cancel_reason", "deadline", "last_barrier", "barriers",
                  "cancel_closed")
 
@@ -133,6 +133,10 @@ class RequestAccount:
             "pallas_groups": 0, "dispatches": 0,
             "dispatches_saved": 0}
         self.stages: Dict[str, dict] = {}
+        # per-sync-site straggler evidence (parallel/dist guarded
+        # collectives, fed via obs/fleetobs.SyncObserver): worst spread,
+        # the rank most often last, attributed cause counts
+        self.sync_sites: Dict[str, dict] = {}
 
     # -- feeds (each must never raise into the work it observes) ----------
     def note_counters(self, deltas: dict) -> None:
@@ -230,6 +234,25 @@ class RequestAccount:
                 if v:
                     row[k] = row.get(k, 0) + int(v)
 
+    def note_sync_point(self, site: str, spread_s: float, slowest: int,
+                        cause: str, ranks_seen: int) -> None:
+        """One guarded collective sync's arrival evidence charged to
+        this request (the ``straggler`` profile section)."""
+        with self._lock:
+            row = self.sync_sites.get(site)
+            if row is None:
+                row = self.sync_sites[site] = {
+                    "count": 0, "spread_s_sum": 0.0, "max_spread_s": 0.0,
+                    "slowest_rank": -1, "causes": {}}
+            row["count"] += 1
+            row["spread_s_sum"] += spread_s
+            if spread_s >= row["max_spread_s"]:
+                row["max_spread_s"] = spread_s
+                row["slowest_rank"] = int(slowest)
+                row["worst_cause"] = cause
+            row["causes"][cause] = row["causes"].get(cause, 0) + 1
+            row["ranks_seen"] = int(ranks_seen)
+
     # -- cooperative cancellation ------------------------------------------
     def cancel(self, reason: str = "client") -> None:
         """Arm the cancellation flag: the request raises
@@ -290,6 +313,17 @@ class RequestAccount:
                 r["total_s"] = round(r["total_s"], 6)
                 r["max_s"] = round(r["max_s"], 6)
                 stages[name] = r
+            straggler = {}
+            for site, row in self.sync_sites.items():
+                straggler[site] = {
+                    "count": row["count"],
+                    "avg_spread_s": round(
+                        row["spread_s_sum"] / max(1, row["count"]), 6),
+                    "max_spread_s": round(row["max_spread_s"], 6),
+                    "slowest_rank": row["slowest_rank"],
+                    "worst_cause": row.get("worst_cause", ""),
+                    "causes": dict(row["causes"]),
+                    "ranks_seen": row.get("ranks_seen", 0)}
             return {
                 "trace_id": self.trace_id,
                 "tenant": self.tenant,
@@ -320,6 +354,11 @@ class RequestAccount:
                 # plan groups fused / megafused / took the Pallas group
                 # kernels, and the dispatches that saved vs eager
                 "fusion": dict(self.fusion),
+                # which collective sync sites this request waited at,
+                # who was last, and whether the data or the host was
+                # at fault (doc/distributed.md "a rank is slow, not
+                # dead")
+                "straggler": dict(sorted(straggler.items())),
                 "stages": dict(sorted(
                     stages.items(),
                     key=lambda kv: -kv[1]["total_s"])),
@@ -334,12 +373,15 @@ def _process_account() -> Optional[RequestAccount]:
     """The lazy process-default context (the "top-level programmatic
     run").  None when profiling is disabled."""
     global _PROCESS
+    if _PROCESS is not None:
+        # an explicitly-installed account (set_process_trace_id — the
+        # dist trace stitch) outranks the MRTPU_PROFILE gate
+        return _PROCESS
     if not profiling_enabled():
         return None
-    if _PROCESS is None:
-        with _PROC_LOCK:
-            if _PROCESS is None:
-                _PROCESS = RequestAccount(label="process")
+    with _PROC_LOCK:
+        if _PROCESS is None:
+            _PROCESS = RequestAccount(label="process")
     return _PROCESS
 
 
@@ -482,6 +524,30 @@ def note_span(name: str, cat: str, dur_s: float, attrs: dict) -> None:
     acct = active_account()
     if acct is not None:
         acct.note_span(name, cat, dur_s, attrs)
+
+
+def note_sync(site: str, spread_s: float, slowest: int, cause: str,
+              ranks_seen: int) -> None:
+    """Feed point for collective sync straggler evidence
+    (obs/fleetobs.SyncObserver → the profile's ``straggler`` section)."""
+    acct = active_account()
+    if acct is not None:
+        acct.note_sync_point(site, spread_s, slowest, cause, ranks_seen)
+
+
+def set_process_trace_id(trace_id: str) -> None:
+    """Pin the process-default context to a GIVEN trace id — the
+    cross-process stitch: mrlaunch mints one id, ships it via
+    ``MRTPU_DIST_TRACE_ID``, and every rank installs it here so all
+    ranks' spans/journals/flight dumps carry the launch's single id.
+    Creates the process account if needed (even under MRTPU_PROFILE=0 —
+    an explicit launch-provided id outranks the implicit-context knob)."""
+    global _PROCESS
+    with _PROC_LOCK:
+        if _PROCESS is None:
+            _PROCESS = RequestAccount(trace_id=trace_id, label="dist")
+        else:
+            _PROCESS.trace_id = trace_id
 
 
 def barrier_check() -> None:

@@ -83,6 +83,17 @@ class FlightRecorder:
                 doc["metrics"] = _metrics.snapshot()
         except Exception:
             pass
+        try:
+            # multi-process data plane: the peer lease table (heartbeat
+            # ages, fence state) — a PeerLostError dump must answer
+            # "who died, and when" from the artifact alone.  Late
+            # import; parallel/dist pulls in no obs/ at module level.
+            from ..parallel import dist as _dist
+            rt = _dist.active()
+            if rt is not None:
+                doc["dist"] = _dist.lease_table(rt)
+        except Exception:
+            pass
         return doc
 
     def dump(self, reason: str = "manual") -> Optional[str]:

@@ -153,6 +153,7 @@ class Span:
             "dur": round((self.t1 - self.t0) * 1e6, 1),
             "pid": tr.pid, "tid": threading.get_ident() & 0x7FFFFFFF,
             "id": self.span_id, "parent": self.parent_id,
+            "wall": round(tr.wall_epoch + self.t0, 6),
             "args": self.attrs,
         }
         if self.trace_id is not None:
@@ -176,6 +177,10 @@ class Tracer:
         self.proc_attrs: dict = {}
         self.jax_annotations = env_flag("MRTPU_TRACE_JAX", True)
         self.epoch = time.perf_counter()
+        # wall-clock origin of the perf_counter timeline: lets a
+        # cross-process merge (trace_view over per-rank shards) rebase
+        # each process's private ts epoch onto one shared clock
+        self.wall_epoch = time.time() - time.perf_counter()
         self.pid = os.getpid()
         self._sinks: List[object] = []
         self._ring: Optional["RingSink"] = None

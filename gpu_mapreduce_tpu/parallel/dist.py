@@ -303,6 +303,11 @@ class DistRuntime:
                                    heartbeat_s=self.heartbeat_s,
                                    lease_s=self.lease_s, gen=gen)
         self.peer_lost: Optional[PeerLostError] = None
+        # fleet-observability attachments (armed by _arm_observability
+        # from init_from_env; None when gated off): the per-sync-site
+        # straggler observer and the per-rank metrics dump channel
+        self.sync_obs = None
+        self.metrics_dumper = None
 
     # -- observation -------------------------------------------------------
     def peer_ranks(self) -> List[int]:
@@ -340,6 +345,18 @@ class DistRuntime:
         if self.fenced():
             self._note_fenced(site)
             raise RankFencedError(self.rank, site)
+
+        # arrival stamp BEFORE dispatching into the collective (and
+        # AFTER fault_point — an injected delay lands in the stamp):
+        # durable by the time any peer's sync completes, which is what
+        # lets every rank compute the arrival spread locally with zero
+        # extra collectives.  Observing a sync must never fail it.
+        obs, arec = self.sync_obs, None
+        if obs is not None:
+            try:
+                arec = obs.arrive(site)
+            except Exception:
+                arec = None
 
         done = threading.Event()
         box: list = [None, None]     # [result, exception]
@@ -384,6 +401,11 @@ class DistRuntime:
                                    f"transport error {box[1]!r}")
                     time.sleep(poll)
             raise box[1]
+        if arec is not None:
+            try:
+                obs.complete(site, arec)
+            except Exception:
+                pass
         return box[0]
 
     def _trip(self, site: str, dead: List[int], reason: str):
@@ -422,7 +444,71 @@ class DistRuntime:
             pass
 
     def stop(self, leave: bool = True) -> None:
+        if self.metrics_dumper is not None:
+            try:
+                self.metrics_dumper.stop("exit")
+            except Exception:
+                pass
+        if self.sync_obs is not None:
+            try:
+                self.sync_obs.close()
+            except Exception:
+                pass
         self.heartbeat.stop(leave=leave)
+
+
+def lease_table(rt: DistRuntime) -> dict:
+    """Point-in-time snapshot of the generation's lease/fence state —
+    what a ``PeerLostError`` flight dump embeds so "who died, and when"
+    is answerable from the artifact alone (obs/flight.py attaches it as
+    ``doc["dist"]``)."""
+    now = time.time()
+    peers = {}
+    for r in range(rt.world):
+        beat = read_beat(rt.rundir, r, rt.gen)
+        row = {"fenced": is_fenced(rt.rundir, r, rt.gen),
+               "expired": beat_expired(beat, rt.skew_s, now)}
+        if beat is None:
+            row["missing"] = True
+        else:
+            try:
+                row["age_s"] = round(now - float(beat["ts"]), 3)
+                row["expires_in_s"] = round(float(beat["expires"]) - now,
+                                            3)
+                row["seq"] = int(beat.get("seq", 0))
+                row["state"] = str(beat.get("state", ""))
+                row["pid"] = beat.get("pid")
+            except (KeyError, TypeError, ValueError):
+                row["unreadable"] = True
+        peers[str(r)] = row
+    return {"rank": rt.rank, "world": rt.world, "gen": rt.gen,
+            "rundir": rt.rundir, "fenced": rt.fenced(),
+            "lease_s": rt.lease_s, "skew_s": rt.skew_s,
+            "dead": [r for r, row in peers.items() if row["expired"]],
+            "peers": peers}
+
+
+def note_sync_rows(counts_mat) -> None:
+    """Feed the straggler classifier the shuffle count matrix's
+    per-destination row totals (column sums of the [P, P] src×dest
+    matrix every rank already pulls at the phase-1 count sync) — the
+    data-skew half of the cause verdict.  Crash-proof no-op outside the
+    data plane."""
+    rt = _ACTIVE
+    if rt is None or rt.sync_obs is None:
+        return
+    try:
+        rows = [int(x) for x in counts_mat.sum(axis=0)]
+        # multiple local devices: P = world * ndev shards — fold shard
+        # totals onto their owning rank (launcher slices contiguously)
+        P = len(rows)
+        if P != rt.world and rt.world > 0 and P % rt.world == 0:
+            per = P // rt.world
+            rows = [sum(rows[r * per:(r + 1) * per])
+                    for r in range(rt.world)]
+        rt.sync_obs.note_rows(rows)
+    except Exception:
+        pass
 
 
 _ACTIVE: Optional[DistRuntime] = None
@@ -502,7 +588,58 @@ def init_from_env() -> Optional[DistRuntime]:
                   "first launch)").set(gen)
     except Exception:
         pass
+    _arm_observability(rt)
     return rt
+
+
+def _arm_observability(rt: DistRuntime) -> None:
+    """Per-rank fleet-observability wiring (doc/observability.md
+    "Fleet & mesh"): install the launcher's trace id so every span /
+    journal record / flight dump this rank emits carries the LAUNCH's
+    single id, open this rank's trace shard under the shared run dir,
+    and arm the sync-site straggler observer + the metrics dump
+    channel.  Every piece is knob-gated and individually crash-proof —
+    observability must never take down the data plane it watches."""
+    from ..utils.env import env_flag
+    tid = env_str("MRTPU_DIST_TRACE_ID", "")
+    if tid:
+        try:
+            from ..obs.context import set_process_trace_id
+            set_process_trace_id(tid)
+        except Exception:
+            pass
+    if env_flag("MRTPU_DIST_TRACE", True):
+        try:
+            from ..obs import get_tracer
+            get_tracer().enable(jsonl=os.path.join(
+                rt.rundir, f"trace-r{rt.rank}.jsonl"))
+        except Exception:
+            pass
+    if env_str("MRTPU_FLIGHT", "") == "":
+        # no explicit flight config: arm the recorder at the shared run
+        # dir, so every rank's ring (with the lease table) is dumpable
+        # on PeerLost — the post-mortem satellite.  MRTPU_FLIGHT=0
+        # still disables; an explicit dir was already armed at import.
+        try:
+            from ..obs import flight as _flight
+            _flight.enable(dir=rt.rundir)
+        except Exception:
+            pass
+    if env_flag("MRTPU_DIST_SYNC_OBS", True):
+        try:
+            from ..obs.fleetobs import SyncObserver
+            rt.sync_obs = SyncObserver(rt.rundir, rt.rank, rt.world,
+                                       gen=rt.gen)
+        except Exception:
+            rt.sync_obs = None
+    if env_flag("MRTPU_DIST_METRICS", True):
+        try:
+            from ..obs.fleetobs import RankMetricsDumper
+            rt.metrics_dumper = RankMetricsDumper(rt.rundir, rt.rank,
+                                                  gen=rt.gen)
+            rt.metrics_dumper.start()
+        except Exception:
+            rt.metrics_dumper = None
 
 
 def guard_call(site: str, fn: Callable, *args, **kwargs):

@@ -697,6 +697,10 @@ def _exchange_impl(skv: ShardedKV, dest, transport: int,
             return cm, sm
 
         counts_mat, stats_mat = _dist.guard_call("count_sync", _pull)
+        # straggler attribution (obs/fleetobs): hand the per-dest row
+        # totals to the sync observer so the NEXT syncs' cause verdict
+        # (data_skew vs host_slow) has the count-matrix evidence
+        _dist.note_sync_rows(counts_mat)
     # round budget: pad buckets to ~the mean nonzero bucket, not the max —
     # under key skew (RMAT hubs) the max bucket is far above the mean and
     # single-round padding would inflate the exchanged volume by that

@@ -287,6 +287,12 @@ class ServeClient:
     def stats(self) -> dict:
         return self._req("GET", "/v1/stats")
 
+    def fleet_metrics(self) -> dict:
+        """``GET /metrics/fleet.json`` (router-only): every federation
+        member — replicas and data-plane ranks — with liveness,
+        staleness and its merged registry snapshot (``mrctl top``)."""
+        return self._req("GET", "/metrics/fleet.json")
+
     def drain(self) -> dict:
         return self._req("POST", "/v1/drain")
 

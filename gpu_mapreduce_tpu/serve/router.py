@@ -76,7 +76,13 @@ class Router:
         metrics.enable_metrics()
         enable_fleet_metrics(self.fleet)
         self._listener = httpd.MetricsServer(
-            port=self.port, routes=[("/v1/", self._handle)],
+            port=self.port,
+            routes=[("/v1/", self._handle),
+                    # fleet-wide metrics federation (doc/observability
+                    # .md "Fleet & mesh"); prefix-matches the .json
+                    # variant too, and cannot shadow the builtin
+                    # /metrics (exact-matched before routes)
+                    ("/metrics/fleet", self._metrics_fleet)],
             health=self._health)
         self.port = self._listener.start()
         atomic_write_json(os.path.join(self.fleet_dir, "router.json"),
@@ -470,6 +476,73 @@ class Router:
         return 200, {"fleet_dir": self.fleet_dir,
                      "healthy": self.fleet.healthy(),
                      "replicas": replicas}, "application/json", None
+
+    # -- metrics federation -------------------------------------------------
+    def _fleet_members(self, headers: Optional[dict] = None
+                       ) -> List[dict]:
+        """Every federation member with its registry snapshot: the
+        replicas from the lease table (live ones scraped over
+        ``/metrics.json``), the data-plane ranks from the run dir's
+        dump channel (``metrics-r<rank>.json``).  A member that is dead
+        or unreachable is STILL a row — up=0, stale=1 — never silently
+        absent."""
+        from ..obs.fleetobs import (member_row, rank_dump_stale,
+                                    read_rank_dumps)
+        from ..utils.env import env_str
+        now = time.time()
+        members: List[dict] = []
+        for rid, lease in sorted(self.fleet.peers().items()):
+            state = self.fleet.replica_state(rid, lease)
+            try:
+                age = max(0.0, now - float(lease.get("ts", now)))
+            except (TypeError, ValueError):
+                age = 0.0
+            snap = None
+            if state in ("ready", "draining", "degraded"):
+                out = self._proxy(rid, "GET", "/metrics.json", b"",
+                                  headers)
+                if out is not None and out[0] == 200:
+                    try:
+                        snap = json.loads(out[1].decode())
+                    except ValueError:
+                        snap = None
+            members.append(member_row(
+                replica=rid, up=snap is not None,
+                stale=snap is None, age_s=age, metrics=snap,
+                state=state))
+        rundir = env_str("MRTPU_FLEET_RUNDIR", "") \
+            or env_str("MRTPU_DIST_RUNDIR", "")
+        if rundir:
+            for rank, doc in sorted(read_rank_dumps(rundir).items()):
+                age = min(rank_dump_stale(doc, now), 9e9)
+                try:
+                    every = float(doc.get("every_s", 5.0))
+                except (TypeError, ValueError):
+                    every = 5.0
+                fresh = age <= 3.0 * every + 1.0
+                members.append(member_row(
+                    rank=str(rank), up=fresh, stale=not fresh,
+                    age_s=age, metrics=doc.get("metrics"),
+                    state=str(doc.get("reason", ""))))
+        return members
+
+    def _metrics_fleet(self, method: str, path: str, body: bytes,
+                       headers: Optional[dict] = None) -> tuple:
+        """``GET /metrics/fleet`` (Prometheus text) and
+        ``/metrics/fleet.json`` — the whole fleet's series under one
+        scrape, ``{replica,rank}``-labeled, with honest staleness.
+        Ungated, like the builtin ``/metrics`` it federates."""
+        if method != "GET":
+            return 405, {"error": "GET only"}, "application/json", None
+        from ..obs.fleetobs import federate_text
+        members = self._fleet_members(headers)
+        if path.endswith(".json"):
+            return 200, {"fleet_dir": self.fleet_dir,
+                         "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                         "members": members}, "application/json", None
+        return 200, federate_text(members), \
+            "text/plain; version=0.0.4; charset=utf-8", None
 
     def _broadcast(self, method: str, path: str, body: bytes,
                    headers: Optional[dict] = None) -> tuple:

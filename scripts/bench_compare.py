@@ -89,6 +89,12 @@ ADVISORY_METRICS = (
     # tests/test_megafuse.py
     ("fusion_v2_dispatches", -1),
     ("group_wall_delta_pct", -1),
+    # fleet-observability row (bench.py --obsdist, detail.obs_dist_ab):
+    # sync-site instrumentation on/off wall delta on the 4-proc
+    # mrlaunch mesh — advisory because multi-process CPU walls are
+    # noisy; the attribution correctness invariants live in
+    # tests/test_obsdist.py
+    ("obs_dist_overhead_pct", -1),
 )
 
 DEFAULT_WINDOW = 3
@@ -178,6 +184,9 @@ def record_metrics(rec: dict) -> Optional[dict]:
             m["wire_compression_ratio"] = w1["compression_ratio"]
         if w1.get("wall_s") is not None:
             m["wire_intcount_sec"] = w1["wall_s"]
+    oab = det.get("obs_dist_ab") or {}
+    if not oab.get("error") and oab.get("overhead_pct") is not None:
+        m["obs_dist_overhead_pct"] = oab["overhead_pct"]
     el = det.get("elastic") or {}
     if not el.get("error"):
         walls = [v for k, v in el.items()

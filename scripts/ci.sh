@@ -17,6 +17,9 @@
 #   scripts/ci.sh dist     # multi-process data plane subset (watchdog/
 #                          # heartbeat fakes + slow multi-rank goldens:
 #                          # peer_kill shrink-and-resume, peer_hang)
+#   scripts/ci.sh obsdist  # fleet observability subset (sync observer/
+#                          # federation units + stitched-trace golden,
+#                          # straggler attribution, federation chaos)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -144,6 +147,18 @@ run_dist_subset_full() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_obsdist_subset_quick() {
+  echo "== obsdist subset (fast): sync observer, federation renderer, trace-dir merge, straggler units =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_obsdist.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_obsdist_subset_full() {
+  echo "== obsdist subset (full): multi-process stitched-trace golden + straggler attribution + federation chaos =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_obsdist.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_fleet_subset_quick() {
   echo "== fleet subset (fast): lease/claim/ring units + router + satellites =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
@@ -181,6 +196,12 @@ if [ "${1:-}" = "dist" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "obsdist" ]; then
+  run_obsdist_subset_quick
+  run_obsdist_subset_full
+  exit 0
+fi
+
 if [ "${1:-}" = "quick" ]; then
   run_lint_quick
   run_plan_subset
@@ -191,6 +212,7 @@ if [ "${1:-}" = "quick" ]; then
   run_overload_subset_quick
   run_fleet_subset_quick
   run_dist_subset_quick
+  run_obsdist_subset_quick
   run_context_subset
   run_elastic_subset_quick
   run_wire_subset_quick
@@ -218,6 +240,7 @@ run_serve_subset_full
 run_overload_subset_full
 run_fleet_subset_full
 run_dist_subset_full
+run_obsdist_subset_full
 run_context_subset
 run_elastic_subset_full
 run_wire_subset_full

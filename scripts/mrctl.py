@@ -12,6 +12,7 @@
     mrctl.py [...] watch SID [--timeout SECS]   # stream /events (no poll)
     mrctl.py [...] slo
     mrctl.py [...] stats
+    mrctl.py [...] top [--watch SECS] [--json]  # fleet member live view
     mrctl.py [...] drain
     mrctl.py [...] shutdown
 
@@ -63,6 +64,43 @@ def _client(args):
         print(f"cannot discover daemon from {state!r}: {e}",
               file=sys.stderr)
         sys.exit(3)
+
+
+def _top_table(doc: dict) -> str:
+    """The ``mrctl top`` member table: one row per federation member
+    (replica or data-plane rank), its liveness/staleness verdict, and
+    the headline straggler number when the member reports one."""
+    rows = [("member", "state", "up", "stale", "age_s", "series",
+             "avg_sync_spread_s")]
+    for m in doc.get("members", []):
+        name = (f"replica:{m['replica']}" if m.get("replica")
+                else f"rank:{m.get('rank', '?')}")
+        snap = m.get("metrics") or {}
+        spread = "-"
+        fam = snap.get("mrtpu_dist_sync_spread_seconds")
+        if fam:
+            tot = cnt = 0.0
+            for s in fam.get("samples", []):
+                tot += float(s.get("sum", 0.0))
+                cnt += float(s.get("count", 0))
+            if cnt:
+                spread = f"{tot / cnt:.3f}"
+        rows.append((name, str(m.get("state", "")),
+                     "1" if m.get("up") else "0",
+                     "1" if m.get("stale") else "0",
+                     f"{m.get('age_s', 0.0):.1f}", str(len(snap)),
+                     spread))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = []
+    for i, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) if j == 0 else c.rjust(w)
+                               for j, (c, w) in enumerate(zip(row,
+                                                              widths))))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    if len(rows) == 1:
+        lines.append("(no federation members)")
+    return "\n".join(lines)
 
 
 def _terminal_code(r: dict) -> int:
@@ -125,6 +163,13 @@ def main(argv=None) -> int:
                          "reached a terminal state by then")
     sub.add_parser("slo")
     sub.add_parser("stats")
+    tp = sub.add_parser("top", help="fleet-wide member table from the "
+                                    "router's /metrics/fleet.json")
+    tp.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                    help="refresh every SECS until interrupted "
+                         "(0 = print once)")
+    tp.add_argument("--json", action="store_true",
+                    help="print the raw federation doc instead")
     sub.add_parser("drain")
     sub.add_parser("shutdown")
     args = p.parse_args(argv)
@@ -203,6 +248,21 @@ def main(argv=None) -> int:
             print(json.dumps(c.slo(), indent=2))
         elif args.cmd == "stats":
             print(json.dumps(c.stats(), indent=2))
+        elif args.cmd == "top":
+            import time as _time
+            while True:
+                doc = c.fleet_metrics()
+                if args.json:
+                    print(json.dumps(doc, indent=2))
+                else:
+                    print(_top_table(doc))
+                if not args.watch:
+                    break
+                try:
+                    _time.sleep(args.watch)
+                except KeyboardInterrupt:
+                    break
+                print()
         elif args.cmd == "drain":
             print(json.dumps(c.drain()))
         elif args.cmd == "shutdown":

@@ -410,6 +410,32 @@ class _Worker:
             atomic_replace(tmp, out_path)
 
 
+def _worker_last_words(w, reason: str, flight: bool = True) -> None:
+    """Best-effort forensic artifacts on the way out.  Workers leave
+    via ``os._exit`` (a wedged gloo context must not stall interpreter
+    teardown), which skips excepthook AND atexit — so the flight ring
+    dump and the final metrics dump must be written HERE, explicitly,
+    before the exit.  Never raises: the exit code is the priority."""
+    if flight:
+        try:
+            from gpu_mapreduce_tpu.obs import flight as _flight
+            rec = _flight.get()
+            if rec is not None:
+                rec.dump(reason)
+        except Exception:
+            pass
+    try:
+        if w.rt.metrics_dumper is not None:
+            w.rt.metrics_dumper.stop(reason)
+    except Exception:
+        pass
+    try:
+        if w.rt.sync_obs is not None:
+            w.rt.sync_obs.close()
+    except Exception:
+        pass
+
+
 def worker_main(argv) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rundir", required=True)
@@ -434,6 +460,10 @@ def worker_main(argv) -> int:
               flush=True)
         write_exit_report(w.rundir, w.rank, w.rt.gen, "peer_lost",
                           dead=e.dead, site=e.site)
+        # every survivor persists its flight ring (with the lease
+        # table — "who died, when") + a final metrics dump: the
+        # post-mortem must not depend on which rank you ask
+        _worker_last_words(w, f"peer_lost:{e.site}")
         # os._exit: a wedged gloo context must not stall interpreter
         # teardown (jax's atexit would try to reach dead peers)
         os._exit(EXIT_PEER_LOST)
@@ -441,8 +471,10 @@ def worker_main(argv) -> int:
         print(f"mrlaunch worker rank {w.rank}: {e}", file=sys.stderr,
               flush=True)
         write_exit_report(w.rundir, w.rank, w.rt.gen, "fenced")
+        _worker_last_words(w, "fenced")
         os._exit(EXIT_FENCED)
     write_exit_report(w.rundir, w.rank, w.rt.gen, "done")
+    _worker_last_words(w, "done", flight=False)
     w.rt.stop()
     os._exit(0)
 
@@ -451,7 +483,8 @@ def worker_main(argv) -> int:
 # the launcher
 # ---------------------------------------------------------------------------
 
-def _spawn_generation(rundir: str, width: int, gen: int):
+def _spawn_generation(rundir: str, width: int, gen: int,
+                      trace_id: str = ""):
     port = _pick_port()
     procs = {}
     for rank in range(width):
@@ -468,6 +501,12 @@ def _spawn_generation(rundir: str, width: int, gen: int):
             "MRTPU_DIST_RUNDIR": rundir,
             "MRTPU_DIST_GEN": str(gen),
         })
+        if trace_id:
+            # cross-process trace stitch: every rank of every
+            # generation installs the LAUNCH's one trace id
+            # (dist._arm_observability), so all ranks' spans, journal
+            # records and flight dumps join under a single id
+            env["MRTPU_DIST_TRACE_ID"] = trace_id
         log = open(os.path.join(rundir, f"g{gen}-rank{rank}.log"), "ab")
         procs[rank] = (subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--worker",
@@ -538,13 +577,18 @@ def run_launcher(args, workload_spec: dict) -> dict:
 
     grace = args.grace
     width, gen = args.np, 0
+    # ONE trace id for the whole launch, constant across shrink
+    # generations (a takeover is the same story, not a new one);
+    # overridable so an outer orchestrator can stitch even wider
+    from gpu_mapreduce_tpu.utils.env import env_str
+    trace_id = env_str("MRTPU_DIST_TRACE_ID", "") or os.urandom(8).hex()
     t_start = time.monotonic()
     t_detect = None
     recover_s = None
     history = []
 
     while True:
-        procs = _spawn_generation(rundir, width, gen)
+        procs = _spawn_generation(rundir, width, gen, trace_id)
         if t_detect is not None and recover_s is None:
             # recovery clock: first fault observation → every rank of
             # the shrunk generation heartbeating (data plane re-formed)
@@ -624,6 +668,7 @@ def run_launcher(args, workload_spec: dict) -> dict:
         width, gen = new_width, gen + 1
 
     summary = {"generations": gen + 1, "final_width": width,
+               "trace_id": trace_id,
                "history": history,
                "recover_seconds": recover_s,
                "wall_seconds": time.monotonic() - t_start}

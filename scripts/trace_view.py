@@ -5,6 +5,7 @@ Usage:
                                              [--cat CAT] [--json]
     python scripts/trace_view.py TRACE.jsonl --traces
     python scripts/trace_view.py TRACE.jsonl --trace ID [--json]
+    python scripts/trace_view.py RUNDIR [--traces | --trace ID] [--json]
     python scripts/trace_view.py --probe PROBE.jsonl [--json]
 
 TRACE.jsonl is what a run writes under MRTPU_TRACE=path (or
@@ -12,6 +13,17 @@ MapReduce(trace=path)).  --chrome additionally writes the
 Perfetto-loadable Chrome trace-event file; --cat filters to one span
 category (mr_op / shuffle / ingest / oink / app / soak); --json prints
 the aggregate as JSON instead of the table.
+
+A DIRECTORY path is a multi-process run dir (scripts/mrlaunch.py):
+every ``trace-r<rank>.jsonl`` shard is indexed as ONE run — each
+rank's private ``ts`` epoch is rebased onto the shared wall clock (the
+events' ``wall`` field), span ids are namespaced per rank so parent
+links cannot collide, and --trace additionally renders the per-rank
+timeline plus the collective sync-point alignment table (arrival
+spread, slowest rank, attributed cause) from the run dir's
+``rank<k>.sync.jsonl`` records.  All ranks of an mrlaunch run share
+one trace id (``launch.json``'s ``trace_id``), so ``--trace`` shows
+the whole fleet's request.
 
 --traces lists the request trace ids in the file (obs/context.py: a
 serve session, a top-level OINK run, or the process context) with span
@@ -107,6 +119,133 @@ def probe_table(events) -> str:
 
 _BYTE_ARGS = ("shuffle_sent_bytes", "shuffle_pad_bytes",
               "spill_write_bytes", "spill_read_bytes")
+
+
+def read_trace_dir(path: str):
+    """Merge a run dir's per-rank shards (``trace-r<k>.jsonl``) into
+    one event stream: ``(events, n_shards)``.
+
+    Every process's ``ts`` is microseconds from its OWN perf_counter
+    epoch — meaningless across processes.  Each event also carries
+    ``wall`` (absolute wall-clock seconds of span start), so each
+    shard gets one offset rebasing its whole timeline onto the run's
+    shared clock (relative placement within a shard is preserved
+    exactly).  Span ids are namespaced per rank — two ranks' span #7
+    must not merge into one parent chain — and every event gains a
+    top-level ``rank``."""
+    import glob
+    from gpu_mapreduce_tpu.obs import read_jsonl
+    per_rank = []
+    for sp in sorted(glob.glob(os.path.join(path, "trace-r*.jsonl"))):
+        base = os.path.basename(sp)
+        try:
+            rank = int(base[len("trace-r"):-len(".jsonl")])
+        except ValueError:
+            continue
+        per_rank.append((rank, read_jsonl(sp)))
+    # the run's zero: the earliest shard epoch (wall minus its own ts)
+    t0 = None
+    for _r, evs in per_rank:
+        for ev in evs:
+            w = ev.get("wall")
+            if w is not None:
+                w0 = float(w) - float(ev.get("ts", 0.0)) / 1e6
+                t0 = w0 if t0 is None else min(t0, w0)
+    out = []
+    for rank, evs in per_rank:
+        off = None
+        if t0 is not None:
+            for ev in evs:
+                w = ev.get("wall")
+                if w is not None:
+                    off = (float(w) - t0) * 1e6 \
+                        - float(ev.get("ts", 0.0))
+                    break
+        ns = (rank + 1) << 32
+        for ev in evs:
+            ev = dict(ev)
+            ev["rank"] = rank
+            if off is not None:
+                ev["ts"] = round(float(ev.get("ts", 0.0)) + off, 1)
+            if ev.get("id"):
+                ev["id"] = int(ev["id"]) + ns
+            if ev.get("parent"):
+                ev["parent"] = int(ev["parent"]) + ns
+            out.append(ev)
+    out.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return out, len(per_rank)
+
+
+def rank_timeline(events) -> dict:
+    """{rank: {spans, start_s, end_s, wall_s}} over a merged stream —
+    the per-rank lanes of the stitched timeline."""
+    out = {}
+    for ev in events:
+        r = ev.get("rank")
+        if r is None:
+            r = (ev.get("args") or {}).get("rank")
+        if r is None:
+            continue
+        row = out.setdefault(int(r), {"spans": 0, "_t0": None,
+                                      "_t1": None})
+        row["spans"] += 1
+        a = float(ev.get("ts", 0.0))
+        b = a + float(ev.get("dur", 0.0))
+        row["_t0"] = a if row["_t0"] is None else min(row["_t0"], a)
+        row["_t1"] = b if row["_t1"] is None else max(row["_t1"], b)
+    for row in out.values():
+        t0v, t1v = row.pop("_t0") or 0.0, row.pop("_t1") or 0.0
+        row["start_s"] = round(t0v / 1e6, 6)
+        row["end_s"] = round(t1v / 1e6, 6)
+        row["wall_s"] = round((t1v - t0v) / 1e6, 6)
+    return out
+
+
+def sync_alignment(rundir: str) -> list:
+    """The run's collective sync points, deduped across the ranks that
+    each recorded the same (gen, site, seq): spread, slowest rank,
+    attributed cause — the per-sync-point rank alignment the stitched
+    timeline is read against."""
+    from gpu_mapreduce_tpu.obs.fleetobs import read_sync_records
+    best = {}
+    for rec in read_sync_records(rundir):
+        if rec.get("kind") != "spread":
+            continue
+        key = (rec.get("gen"), rec.get("site"), rec.get("seq"))
+        cur = best.get(key)
+        if cur is None or rec.get("ranks_seen", 0) > \
+                cur.get("ranks_seen", 0):
+            best[key] = rec
+    return [best[k] for k in sorted(best, key=lambda k: (str(k[0]),
+                                                         str(k[1]),
+                                                         k[2] or 0))]
+
+
+def dist_report(events, rundir: str) -> str:
+    """The merged-run appendix: per-rank lanes + sync alignment."""
+    lines = ["", "per-rank timeline:"]
+    tl = rank_timeline(events)
+    for r in sorted(tl):
+        row = tl[r]
+        lines.append(f"  rank {r}: {row['spans']:6d} spans  "
+                     f"[{row['start_s']:.4f}s – {row['end_s']:.4f}s]  "
+                     f"{row['wall_s']:.4f}s wall")
+    if not tl:
+        lines.append("  (no rank-tagged events)")
+    syncs = sync_alignment(rundir)
+    lines += ["", "sync points (arrival spread across ranks):"]
+    if not syncs:
+        lines.append("  (no sync records under this run dir)")
+    for rec in syncs:
+        arr = rec.get("arrivals") or {}
+        lanes = " ".join(f"r{k}+{v:.3f}s"
+                         for k, v in sorted(arr.items(),
+                                            key=lambda kv: kv[1]))
+        lines.append(f"  {rec.get('site'):12s} #{rec.get('seq')}"
+                     f"  spread {rec.get('spread_s', 0.0):.4f}s"
+                     f"  slowest r{rec.get('slowest')}"
+                     f"  cause {rec.get('cause')}  [{lanes}]")
+    return "\n".join(lines)
 
 
 def trace_index(events) -> dict:
@@ -259,7 +398,15 @@ def main(argv) -> int:
             return 1
     from gpu_mapreduce_tpu.obs import (aggregate_ops, per_op_table,
                                        read_jsonl, write_chrome_trace)
-    events = read_jsonl(path)
+    rundir = path if os.path.isdir(path) else None
+    if rundir is not None:
+        events, nshards = read_trace_dir(rundir)
+        if not nshards:
+            print(f"no trace-r*.jsonl shards under {rundir}",
+                  file=sys.stderr)
+            return 1
+    else:
+        events = read_jsonl(path)
     if cat:
         events = [e for e in events if e.get("cat") == cat]
     if list_traces:
@@ -276,14 +423,24 @@ def main(argv) -> int:
         return 0
     if trace is not None:
         if as_json:
-            print(json.dumps(trace_profile(events, trace), indent=2))
+            prof = trace_profile(events, trace)
+            if rundir is not None:
+                mine = [e for e in events if e.get("trace") == trace]
+                prof["ranks"] = rank_timeline(mine)
+                prof["sync_points"] = sync_alignment(rundir)
+            print(json.dumps(prof, indent=2))
         else:
             print(trace_report(events, trace))
+            if rundir is not None:
+                mine = [e for e in events if e.get("trace") == trace]
+                print(dist_report(mine, rundir))
         return 0
     if as_json:
         print(json.dumps(aggregate_ops(events), indent=2))
     else:
         print(per_op_table(events))
+        if rundir is not None:
+            print(dist_report(events, rundir))
     if chrome:
         n = write_chrome_trace(chrome, events)
         print(f"\nwrote {n} events -> {chrome}", file=sys.stderr)
