@@ -317,6 +317,8 @@ WIRE_MODE = None   # --wire {0,1,ab} (or BENCH_WIRE): compressed-vs-raw
 #                    shuffle exchange A/B on the shuffle-bound workloads
 OBSDIST_MODE = False  # --obsdist (or BENCH_OBSDIST=1): 4-proc mrlaunch
 #                       wordfreq with sync-site instrumentation on vs off
+CACHE_MODE = None  # --cache {0,1,ab} (or BENCH_CACHE): cold-restart vs
+#                    warm-store caching-tier A/B (utils/cas.py)
 GATE = False       # --gate: after the run, regress-check against the
 #                    BENCH_r*.json trailing baseline (scripts/
 #                    bench_compare.py) and exit nonzero on a trip
@@ -609,6 +611,97 @@ def serve_ab_record() -> dict:
         if srv is not None:
             srv.shutdown()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def cache_ab_record(mode: str) -> dict:
+    """``--cache {0,1,ab}``: cold-restart vs warm-store A/B of the
+    content-addressed caching tier (doc/perf.md#the-caching-tier).
+
+    Each arm runs the same protocol: start a daemon, submit the
+    canonical wordfreq workload, SHUT THE DAEMON DOWN (fresh state dir
+    + cleared in-process plan cache = a cold restart), then resubmit
+    the byte-identical script to a new daemon.  Arm ``0`` disarms the
+    store (``MRTPU_CAS=0``): the restart recompiles and re-executes.
+    Arm ``1`` shares one store across the restart: the second daemon
+    must serve a verified memo hit — 0 plan compiles, 0 dispatches
+    (``restart.memo_hit`` / ``restart.plan_misses == 0``).  Recorded
+    into ``detail.cache_ab`` → the advisory ``cache_warm_restart_sec``
+    / ``cache_result_hit_sec`` rows of scripts/bench_compare.py."""
+    import shutil
+    import tempfile
+    from gpu_mapreduce_tpu.plan.cache import plan_cache
+    from gpu_mapreduce_tpu.serve import Server, ServeClient
+    from gpu_mapreduce_tpu.utils.cas import reset_store
+
+    def run(arm: str) -> dict:
+        tmp = tempfile.mkdtemp(prefix=f"bench_cache{arm}_")
+        saved = {k: os.environ.get(k)
+                 for k in ("MRTPU_CAS", "MRTPU_CAS_DIR", "MRTPU_MEMOIZE",
+                           "MRTPU_JIT_PERSIST")}
+        os.environ["MRTPU_CAS"] = arm
+        os.environ["MRTPU_CAS_DIR"] = os.path.join(tmp, "cas")
+        # the XLA disk cache stays as bench configured it globally —
+        # this A/B isolates the plan/memo tiers, whose effect is
+        # measurable on every backend
+        os.environ["MRTPU_JIT_PERSIST"] = "0"
+        reset_store()
+        try:
+            corpus = os.path.join(tmp, "corpus.txt")
+            with open(corpus, "w") as f:
+                for i in range(300000):
+                    f.write(f"w{i % 4096} ")
+            script = (f"variable files index {corpus}\n"
+                      f"set fuse 1\n"
+                      f"wordfreq 5 -i v_files\n")
+            rec = {}
+            for phase in ("cold", "restart"):
+                # a COLD restart, in process: fresh daemon state dir
+                # and a cleared in-memory plan cache — what survives
+                # is exactly what the on-disk store preserved
+                plan_cache().clear()
+                srv = Server(port=0, workers=1,
+                             state_dir=os.path.join(tmp, f"st_{phase}"))
+                port = srv.start()
+                try:
+                    c = ServeClient.local(port)
+                    res = c.wait(
+                        c.submit(script=script, tenant="bench")["id"],
+                        timeout=600)
+                    if res.get("status") != "done":
+                        raise RuntimeError(f"cache {arm}/{phase} run "
+                                           f"failed: {res.get('error')}")
+                    meta = res["meta"]
+                    pc = meta["plan_cache"]["plan"]
+                    rec[phase] = {
+                        "wall_s": meta["wall_s"],
+                        "dispatches": meta["dispatches"],
+                        "plan_misses": pc["misses"],
+                        "plan_hits": pc["hits"],
+                        "memo_hit": bool((meta.get("memo") or {}
+                                          ).get("hit")),
+                    }
+                finally:
+                    srv.shutdown()
+            rec["result_hit"] = rec["restart"]["memo_hit"] and \
+                rec["restart"]["dispatches"] == 0 and \
+                rec["restart"]["plan_misses"] == 0
+            return rec
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            reset_store()
+            plan_cache().clear()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    out = {}
+    if mode in ("0", "ab"):
+        out["store_off"] = run("0")
+    if mode in ("1", "ab"):
+        out["store_on"] = run("1")
+    return out
 
 
 def profile_ab_record() -> dict:
@@ -1023,6 +1116,14 @@ def run_bench(engine, backend_err):
         except Exception:
             detail["obs_dist_ab"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if CACHE_MODE:
+        # --cache {0,1,ab}: cold-restart vs warm-store caching-tier A/B
+        # (utils/cas.py); failures must not cost the headline line
+        try:
+            detail["cache_ab"] = cache_ab_record(CACHE_MODE)
+        except Exception:
+            detail["cache_ab"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     if os.environ.get("BENCH_PROFILE_AB", "1") != "0":
         # trace-context armed-vs-disarmed micro A/B (obs/context.py):
         # cheap (~seconds), recorded on every round so the advisory
@@ -1053,7 +1154,7 @@ def run_bench(engine, backend_err):
 
 def main():
     global FUSE_MODE, OVERLAP_MODE, SERVE_MODE, ELASTIC_MODE, GATE, \
-        WIRE_MODE, OBSDIST_MODE
+        WIRE_MODE, OBSDIST_MODE, CACHE_MODE
     argv = sys.argv[1:]
     GATE = "--gate" in argv or os.environ.get("BENCH_GATE") == "1"
     if "--fuse" in argv:
@@ -1078,6 +1179,13 @@ def main():
         WIRE_MODE = os.environ.get("BENCH_WIRE") or None
     if WIRE_MODE not in (None, "0", "1", "ab"):
         raise SystemExit(f"--wire takes 0, 1 or ab, got {WIRE_MODE!r}")
+    if "--cache" in argv:
+        i = argv.index("--cache")
+        CACHE_MODE = argv[i + 1] if i + 1 < len(argv) else "ab"
+    else:
+        CACHE_MODE = os.environ.get("BENCH_CACHE") or None
+    if CACHE_MODE not in (None, "0", "1", "ab"):
+        raise SystemExit(f"--cache takes 0, 1 or ab, got {CACHE_MODE!r}")
     SERVE_MODE = "--serve" in argv or \
         os.environ.get("BENCH_SERVE") == "1"
     ELASTIC_MODE = "--elastic" in argv or \

@@ -53,6 +53,18 @@ def atomic_save(path: str, arr: np.ndarray, allow_pickle: bool = False
     # crash after the rename can lose the directory entry of a run a
     # manifest already references — file durable, name not
     atomic_replace(tmp, path)
+    # chunk dedup (utils/cas.py): when the fleet's content store is
+    # armed, re-home the run file as a hardlink to its content object —
+    # replicas spilling identical pages (replayed sessions, shared
+    # inputs) pay the bytes once.  The crc stamp is unchanged (same
+    # bytes); failure leaves the plain file.
+    try:
+        from ..utils.cas import cas_store
+        _store = cas_store()
+        if _store is not None:
+            _store.dedup_file(path)
+    except Exception:
+        pass
     return cw.digest()
 
 

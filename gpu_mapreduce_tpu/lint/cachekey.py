@@ -21,6 +21,12 @@ Covered cache shapes (the repo's two idioms):
   builders — the arguments ARE the key, so ANY env read reachable from
   the body is a finding (read the knob in the caller and pass it in,
   the ``apps/invertedindex._env_knobs`` pattern).
+* content-address key builders (``*_key`` / ``*_digest`` functions
+  that hash — ``serve/memo.memo_key``, ``plan/cache.
+  stable_plan_digest``): their digests name entries in the SHARED
+  on-disk store (utils/cas.py), so an env knob that can influence the
+  bytes but is not derivable from a returned key expression poisons
+  every replica's cache at once, across restarts.
 
 Module-top-level env reads (cache *sizing*, e.g. ``MRTPU_JIT_CACHE``)
 never execute inside a builder and are not findings.
@@ -169,6 +175,42 @@ def check(project: Project) -> List[Finding]:
                 f"({info.module.relpath}:{node.lineno}) whose arguments "
                 f"are its cache key — read it in the caller and pass it "
                 f"in",
+                symbol=rinfo.qual))
+
+    # idiom 3: content-address key builders.  A *_key / *_digest
+    # function that hashes builds a CONTENT ADDRESS shared fleet-wide
+    # through the CAS store — a knob it (or anything it calls) reads
+    # must be derivable from a return expression, else flipping the
+    # knob serves stale store entries on every replica at once.
+    hashers = ("sha256", "sha256_bytes", "sha256_file", "md5",
+               "blake2b", "crc32")
+    for info in graph.funcs.values():
+        node = info.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (node.name.endswith("_key")
+                or node.name.endswith("_digest")):
+            continue
+        if not any(isinstance(n, ast.Call) and
+                   (name_chain(n.func) or ("",))[-1] in hashers
+                   for n in ast.walk(node)):
+            continue
+        keyed: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Return) and n.value is not None:
+                keyed |= _key_knobs(graph, info.module, info, n.value)
+        for knob, rinfo, read in _reachable_env_reads(graph, [info]):
+            if knob in keyed:
+                continue
+            out.append(Finding(
+                "cache-key-missing-knob", rinfo.module.relpath,
+                read.lineno,
+                f"env knob {knob!r} is readable from content-address "
+                f"key builder {info.qual!r} "
+                f"({info.module.relpath}:{node.lineno}) but is not "
+                f"derivable from its returned key expression — "
+                f"replicas sharing the store would keep serving "
+                f"entries the knob should have invalidated",
                 symbol=rinfo.qual))
     return out
 

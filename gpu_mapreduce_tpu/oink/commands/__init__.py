@@ -2,4 +2,5 @@
 COMMANDS registry (the generated style_command.h of the reference)."""
 
 from . import (cc, degree, dump_metrics, dump_plan, dump_trace,  # noqa: F401
-               edges, histo, luby, pagerank, rmat, sssp, tri, wordfreq)
+               edges, histo, invertedindex, luby, pagerank, rmat, sssp,
+               tri, wordfreq)

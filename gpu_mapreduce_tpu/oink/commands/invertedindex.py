@@ -1,0 +1,42 @@
+"""invertedindex command — the flagship app (apps/invertedindex.py)
+behind the script/serve surface.
+
+``invertedindex -i v_files [-o dir]`` runs the full URL→documents
+pipeline on the session's backend (mesh or serial); with ``-o`` the
+per-shard ``part-*`` index files land under the named directory
+(reference myreduce, cuda/InvertedIndex.cu:463-513).  The result
+message carries the (files, pairs, unique urls) triple — deterministic
+across fuse/wire/mesh-width, which is what the serve tier's result
+memoization byte-exactness contract leans on.
+"""
+
+from __future__ import annotations
+
+from ...core.runtime import MRError
+from ..command import Command, command
+
+
+@command("invertedindex")
+class InvertedIndexCmd(Command):
+    ninputs = 1
+    noutputs = 1
+
+    def params(self, args):
+        if args:
+            raise MRError("Illegal invertedindex command")
+
+    def run(self):
+        obj = self.obj
+        if not obj.inputs or obj.inputs[0].paths is None:
+            raise MRError("invertedindex requires a file input (-i)")
+        paths = obj.inputs[0].paths
+        outdir = None
+        if obj.outputs and obj.outputs[0].path is not None:
+            outdir = obj.outputs[0].path
+        from ...apps.invertedindex import InvertedIndex
+        app = InvertedIndex(comm=obj.comm)
+        self.npairs, self.nurl = app.run(paths, outdir=outdir)
+        self.nfiles = len(app.docs)
+        self.message(f"InvertedIndex: {self.nfiles} files, "
+                     f"{self.npairs} pairs, {self.nurl} unique urls")
+        obj.cleanup()

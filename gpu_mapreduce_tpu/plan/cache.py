@@ -25,11 +25,14 @@ flipping a knob can never replay a stale executable.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import threading
 from collections import OrderedDict
 from typing import Callable, Optional
 
-from ..utils.env import env_knob
+from ..utils.env import env_flag, env_knob
 
 
 class LRUCache:
@@ -129,12 +132,19 @@ def cache_stats() -> dict:
     """Structured snapshot of every bounded compile cache — the plan
     cache plus the shuffle's phase1/phase2 jit caches — and the
     cumulative fusion-effectiveness counters (what
-    ``MapReduce.stats()['plan']`` reports)."""
+    ``MapReduce.stats()['plan']`` reports).  ``persistent`` is the
+    on-disk plan tier (zeros when disarmed) so
+    ``mrtpu_plan_cache_hit_ratio{cache="persistent"}`` and the serve
+    per-request deltas cover restarts, not just this process."""
     out = {"plan": plan_cache().stats()}
     from ..parallel import shuffle
     out["shuffle_phase1"] = shuffle.PHASE1_CACHE.stats()
     out["shuffle_phase2"] = shuffle.PHASE2_CACHE.stats()
     out["fusion"] = fusion_stats()
+    pp = persistent_cache()
+    out["persistent"] = pp.stats() if pp is not None else {
+        "enabled": 0, "entries": 0, "bytes": 0,
+        "hits": 0, "misses": 0, "evictions": 0}
     return out
 
 
@@ -213,6 +223,271 @@ def stats_delta(before: dict, after: Optional[dict] = None) -> dict:
         out[cname] = {k: a.get(k, 0) - b.get(k, 0)
                       for k in ("hits", "misses", "evictions")}
     return out
+
+
+# ---------------------------------------------------------------------------
+# the persistent plan tier (doc/perf.md#the-caching-tier): compiled-plan
+# speculation state (exchange caps + megafuse plans) survives process
+# restarts under <cas>/plan/, keyed by a STABLE digest of the in-memory
+# plan-cache key (function objects render as module.qualname, live mesh
+# objects as axis/size/platform signatures).  The actual XLA executables
+# persist next door via JAX's compilation cache (<cas>/xla/ —
+# enable_executable_cache), so a cold replica's first warm-shaped
+# request re-traces against cached speculation state and every compile
+# hits the on-disk executable cache: 0 recompiles.
+#
+# A digest collision (two different lambdas sharing a qualname) is
+# SAFE: the payload is speculation state, validated against the fresh
+# count matrices on every run (plan_holds / gcap checks) — at worst one
+# mega-miss and a v1 re-run, never a wrong result.
+# ---------------------------------------------------------------------------
+
+
+def _mesh_stable(mesh) -> str:
+    """Axis names/sizes + device platform: equal meshes on different
+    hosts (or across restarts) share plan state; a width change keys
+    separately (the caps/plans are per-width shapes)."""
+    shape = dict(getattr(mesh, "shape", None) or {})
+    kind = ""
+    devs = getattr(mesh, "devices", None)
+    if devs is not None:
+        try:
+            first = devs.reshape(-1)[0] if hasattr(devs, "reshape") \
+                else list(devs)[0]
+            kind = getattr(first, "platform", "") or ""
+        except Exception:
+            kind = ""
+    return f"{sorted(shape.items())}|{kind}"
+
+
+def _stable_part(x) -> str:
+    if isinstance(x, (int, float, str, bytes, bool, type(None))):
+        return repr(x)
+    if isinstance(x, tuple):
+        if len(x) == 2 and x[0] == "fn" and callable(x[1]):
+            f = x[1]
+            return (f"fn:{getattr(f, '__module__', '?')}."
+                    f"{getattr(f, '__qualname__', None) or getattr(f, '__name__', '?')}")
+        if len(x) == 2 and x[0] == "mesh" and not isinstance(x[1], str):
+            return f"mesh:{_mesh_stable(x[1])}"
+        return "(" + ",".join(_stable_part(e) for e in x) + ")"
+    raise TypeError(f"no stable rendering for {type(x).__name__}")
+
+
+def stable_plan_digest(key) -> Optional[str]:
+    """Stable cross-process digest of an in-memory plan-cache key, or
+    None when some component has no stable rendering (those plans stay
+    process-local)."""
+    try:
+        text = _stable_part(key)
+    except TypeError:
+        return None
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def to_jsonable(x):
+    """Plan payloads → JSON-safe (tuples → lists, numpy scalars →
+    python); raises TypeError on anything else so an unserializable
+    plan skips persistence instead of storing garbage."""
+    if isinstance(x, (list, tuple)):
+        return [to_jsonable(e) for e in x]
+    if isinstance(x, dict):
+        return {str(k): to_jsonable(v) for k, v in x.items()}
+    if isinstance(x, (str, bool, type(None), int, float)):
+        return x
+    import numpy as np
+    if isinstance(x, np.integer):
+        return int(x)
+    if isinstance(x, np.floating):
+        return float(x)
+    if isinstance(x, np.dtype):
+        return str(x)
+    raise TypeError(f"not plan-serializable: {type(x).__name__}")
+
+
+def from_jsonable(x):
+    """Inverse of :func:`to_jsonable` for plan payloads: lists become
+    tuples again (wire plans are compared and used as dict/cache keys,
+    so tuple-ness is load-bearing)."""
+    if isinstance(x, list):
+        return tuple(from_jsonable(e) for e in x)
+    if isinstance(x, dict):
+        return {k: from_jsonable(v) for k, v in x.items()}
+    return x
+
+
+class PersistentPlanCache:
+    """On-disk plan-state entries under ``<cas>/plan/``, one JSON file
+    per stable key digest, each stamped (``utils/integrity``) and
+    verified on read — a corrupt entry counts an
+    ``mrtpu_integrity_failures_total{artifact="cas"}``, is removed, and
+    reads as a miss (cold compile, never wrong state).  Bounded by
+    ``MRTPU_PLAN_PERSIST_CAP`` entries, oldest-mtime evicted."""
+
+    def __init__(self, root: str):
+        self.dir = os.path.join(root, "plan")
+        self.cap = max(1, env_knob("MRTPU_PLAN_PERSIST_CAP", int, 512))
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.dir, digest + ".json")
+
+    def _note(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.hits += 1
+            else:
+                self.misses += 1
+        try:
+            from ..obs.context import note_plan
+            note_plan("persistent", hit)
+        except Exception:
+            pass
+
+    def load(self, digest: str) -> Optional[dict]:
+        from ..utils.integrity import (digest_bytes,
+                                       record_integrity_failure,
+                                       verify_enabled)
+        path = self._path(digest)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            payload = rec["payload"]
+            body = json.dumps(payload, sort_keys=True).encode()
+            if verify_enabled() and rec.get("c") != digest_bytes(body):
+                raise ValueError("stamp mismatch")
+        except OSError:
+            self._note(False)
+            return None
+        except (ValueError, KeyError, TypeError):
+            # bit-flipped / torn entry: quarantine-by-removal and fall
+            # back to a cold compile — corruption degrades, never lies
+            record_integrity_failure("cas")
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            self._note(False)
+            return None
+        self._note(True)
+        return payload
+
+    def store(self, digest: str, payload: dict) -> bool:
+        """Write (or refresh) one entry; no-op when the stored bytes
+        already match (steady state costs one small read, no write)."""
+        from ..utils.integrity import digest_bytes
+        body = json.dumps(payload, sort_keys=True)
+        rec = json.dumps({"c": digest_bytes(body.encode()),
+                          "payload": payload}, sort_keys=True)
+        path = self._path(digest)
+        try:
+            with open(path) as f:
+                if f.read() == rec:
+                    return False
+        except OSError:
+            pass
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                f.write(rec)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            return False
+        self._evict()
+        return True
+
+    def _evict(self) -> None:
+        try:
+            names = [n for n in os.listdir(self.dir)
+                     if n.endswith(".json")]
+        except OSError:
+            return
+        excess = len(names) - self.cap
+        if excess <= 0:
+            return
+        aged = []
+        for n in names:
+            try:
+                aged.append((os.path.getmtime(
+                    os.path.join(self.dir, n)), n))
+            except OSError:
+                continue
+        for _mt, n in sorted(aged)[:excess]:
+            try:
+                os.remove(os.path.join(self.dir, n))
+                with self._lock:
+                    self.evictions += 1
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        entries = 0
+        nbytes = 0
+        try:
+            for n in os.listdir(self.dir):
+                if not n.endswith(".json"):
+                    continue
+                try:
+                    nbytes += os.path.getsize(os.path.join(self.dir, n))
+                except OSError:
+                    continue
+                entries += 1
+        except OSError:
+            pass
+        with self._lock:
+            return {"enabled": 1, "entries": entries, "bytes": nbytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+_PERSIST: Optional[PersistentPlanCache] = None
+_PERSIST_ROOT: Optional[str] = None
+
+
+def persistent_cache() -> Optional[PersistentPlanCache]:
+    """The on-disk tier singleton (re-rooted when the env changes —
+    tests); None when no CAS root is armed or ``MRTPU_PLAN_PERSIST=0``."""
+    global _PERSIST, _PERSIST_ROOT
+    from ..utils.cas import cas_enabled, cas_root
+    if not cas_enabled() or not env_flag("MRTPU_PLAN_PERSIST", True):
+        return None
+    root = cas_root()
+    with _PLAN_LOCK:
+        if _PERSIST is None or _PERSIST_ROOT != root:
+            _PERSIST = PersistentPlanCache(root)
+            _PERSIST_ROOT = root
+        return _PERSIST
+
+
+def enable_executable_cache() -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``<cas>/xla/`` so
+    the executables behind every jit/shard_map program survive process
+    restarts (the other half of "0 recompiles on a warm-shaped cold
+    replica").  Respects an operator's own ``JAX_COMPILATION_CACHE_DIR``
+    (never overrides it), is disarmed with the tier
+    (``MRTPU_JIT_PERSIST=0`` or no CAS root), and any failure keeps the
+    uncached path — pure optimisation."""
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return None
+    from ..utils.cas import cas_enabled, cas_root
+    if not cas_enabled() or not env_flag("MRTPU_JIT_PERSIST", True):
+        return None
+    path = os.path.join(cas_root(), "xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    except Exception:
+        return None
+    return path
 
 
 # ---------------------------------------------------------------------------

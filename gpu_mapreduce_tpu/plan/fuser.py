@@ -58,7 +58,8 @@ from typing import Optional
 import numpy as np
 
 from ..utils.env import env_flag, env_knob
-from .cache import LRUCache, note_fusion, plan_cache, record_history
+from .cache import (LRUCache, note_fusion, persistent_cache, plan_cache,
+                    record_history, stable_plan_digest)
 from .ir import Plan, PlanStage, frame_signature
 
 # bounded builder cache for the fused jitted programs (same policy as
@@ -857,6 +858,19 @@ def execute_plan(mr, plan: Plan) -> None:
         key = None
         compiled = None
     cache_hit = compiled is not None
+    # the persistent tier (plan/cache.py): an in-memory miss consults
+    # the on-disk plan store before compiling cold — a restarted
+    # replica re-enters warm speculation state (caps + megafuse plans)
+    # and, with the XLA executable cache armed next door, recompiles
+    # nothing
+    pkey = stable_plan_digest(key) if key is not None \
+        and persistent_cache() is not None else None
+    if compiled is None and pkey is not None:
+        payload = persistent_cache().load(pkey)
+        if payload is not None:
+            compiled = _plan_from_payload(payload)
+            plan_cache().put(key, compiled)
+            cache_hit = True
     if compiled is None:
         compiled = CompiledPlan()
         if key is not None:
@@ -916,9 +930,52 @@ def execute_plan(mr, plan: Plan) -> None:
         psp.set(ngroups=gidx,
                 nfused=sum(1 for d in groups_desc if d["fused"]))
     compiled.groups = groups_desc
+    if pkey is not None:
+        # persist what this run learned (no-op when unchanged); an
+        # empty speculation state still marks the digest as seen, so a
+        # restarted replica re-enters the warm path; an unserializable
+        # plan component just stays process-local
+        payload = _plan_payload(compiled)
+        if payload is not None:
+            pp = persistent_cache()
+            if pp is not None:
+                pp.store(pkey, payload)
     record_history({"stages": plan.describe(), "groups": groups_desc,
                     "cache_hit": cache_hit,
                     "cache_key": _key_brief(key)})
+
+
+def _plan_payload(compiled: CompiledPlan) -> Optional[dict]:
+    """CompiledPlan speculation state → JSON-safe payload (None when a
+    component has no stable serialization)."""
+    from .cache import to_jsonable
+    try:
+        # runs is deliberately NOT persisted: it changes every
+        # execution, which would defeat the store's unchanged-bytes
+        # no-op and rewrite the entry per run
+        return {"caps": {str(k): to_jsonable(v)
+                         for k, v in compiled.caps.items()},
+                "mega": {str(k): to_jsonable(v)
+                         for k, v in compiled.mega.items()}}
+    except TypeError:
+        return None
+
+
+def _plan_from_payload(payload: dict) -> CompiledPlan:
+    """Inverse of :func:`_plan_payload`: group indices back to ints,
+    lists back to tuples (wire plans are hashed into FUSED_CACHE keys,
+    so tuple-ness matters)."""
+    from .cache import from_jsonable
+    cp = CompiledPlan()
+    try:
+        cp.caps = {int(k): from_jsonable(v)
+                   for k, v in dict(payload.get("caps") or {}).items()}
+        cp.mega = {int(k): from_jsonable(v)
+                   for k, v in dict(payload.get("mega") or {}).items()}
+        cp.runs = int(payload.get("runs", 0))
+    except (TypeError, ValueError):
+        return CompiledPlan()
+    return cp
 
 
 def _backend_signature(mr):

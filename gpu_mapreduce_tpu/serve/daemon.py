@@ -38,6 +38,14 @@ With ``MRTPU_SERVE_TOKENS`` armed every route needs ``Authorization:
 Bearer <token>`` — 401/403 are decided BEFORE any journal write;
 drain/shutdown need the admin (``*``) token (serve/auth.py).
 
+Serve-journal record kinds: ``serve_submit`` (before the 202),
+``serve_done`` (after the durable result), ``serve_cancel``
+(acknowledged cancels), ``cache_hit`` (the session was served from
+the memo store — replay re-serves, never recomputes), ``serve_gc`` /
+``memo_gc`` / ``cas_gc`` (sweep intents, written BEFORE deletion so a
+kill -9 mid-GC finishes on restart), and ``fleet_claimed``.  Unknown
+kinds are ignored by recovery, so journals roll forward.
+
 Fleet mode (``fleet_dir`` / ``MRTPU_FLEET_DIR`` — doc/serve.md#the-
 serve-fleet): N replicas share one directory tree.  Each replica
 heartbeats a lease (serve/fleet.py), mints globally-unique session ids
@@ -92,6 +100,20 @@ def _collect_serve(reg) -> None:
               "1 while the daemon sheds admissions under resource "
               "pressure (low disk / ENOSPC), else 0"
               ).set(1 if srv.disk.check() else 0)
+    # caching-tier shape (utils/cas.py): scrape-time store census
+    try:
+        from ..utils.cas import cas_store
+        store = cas_store()
+        if store is not None:
+            st = store.stats()
+            reg.gauge("mrtpu_cas_chunks",
+                      "objects resident in the content-addressed store"
+                      ).set(st["chunks"])
+            reg.gauge("mrtpu_cas_bytes",
+                      "bytes resident in the content-addressed store"
+                      ).set(st["bytes"])
+    except Exception:
+        pass
 
 
 class Server:
@@ -154,6 +176,15 @@ class Server:
         # swept by a background thread (0 = keep forever)
         self.ttl_s = max(0.0, env_knob("MRTPU_SERVE_TTL", float, 0.0))
         self.gc_count = 0
+        # caching-tier GC (doc/perf.md#the-caching-tier), folded into
+        # the same TTL sweep: memoized results age out after
+        # MRTPU_MEMO_TTL (0 = keep forever) and unreferenced CAS chunks
+        # are collected after MRTPU_CAS_GRACE seconds unlinked
+        self.memo_ttl_s = max(0.0,
+                              env_knob("MRTPU_MEMO_TTL", float, 0.0))
+        self.cas_grace_s = max(0.0,
+                               env_knob("MRTPU_CAS_GRACE", float, 3600.0))
+        self.cache_gc_count = 0         # entries removed (memo + chunks)
         self.budgets = budgets or TenantBudgets()
         # -- PR 14: the self-protection plane ------------------------------
         # tenant bearer tokens on /v1/ (serve/auth.py; disarmed when
@@ -267,6 +298,14 @@ class Server:
                           {"port": self.port, "pid": os.getpid(),
                            "paused": self.paused, "rid": self.rid})
         self._warm_imports()
+        # arm the persistent caching tier (utils/cas.py): route XLA's
+        # own executable cache under <cas>/xla so a cold replica's first
+        # warm-shaped request recompiles nothing (doc/perf.md)
+        try:
+            from ..plan.cache import enable_executable_cache
+            enable_executable_cache()
+        except Exception:
+            pass
         if self._fleet is not None:
             from . import fleet as _fleet_mod
             self._fleet.join(self.port, self.state_dir,
@@ -361,6 +400,8 @@ class Server:
         cancels: Dict[str, str] = {}    # acknowledged mid-run cancels
         submits: List[dict] = []
         claim_recs: List[tuple] = []    # (idx, fleet_claimed record)
+        cas_intents: List[list] = []    # interrupted CAS chunk sweeps
+        memo_intents: List[list] = []   # interrupted memo-entry sweeps
         for i, r in enumerate(recs):
             if r.get("kind") == "serve_submit":
                 submits.append({**r, "_idx": i})
@@ -373,8 +414,27 @@ class Server:
                 cancels[r.get("sid", "")] = r.get("reason", "client")
             elif r.get("kind") == "serve_gc":
                 gcd.add(r.get("sid", ""))
+            elif r.get("kind") == "cas_gc":
+                cas_intents.append(list(r.get("digests") or []))
+            elif r.get("kind") == "memo_gc":
+                memo_intents.append(list(r.get("keys") or []))
             elif r.get("kind") == "fleet_claimed":
                 claim_recs.append((i, r))
+        if cas_intents or memo_intents:
+            # finish interrupted cache sweeps (journaled-intent replay:
+            # both halves are idempotent — an entry already removed is
+            # skipped, one re-referenced since the intent survives)
+            try:
+                from ..utils.cas import cas_store
+                from . import memo as memo_mod
+                store = cas_store()
+                for digests in cas_intents:
+                    if store is not None:
+                        store.gc_finish(digests)
+                for keys in memo_intents:
+                    memo_mod.sweep_finish(keys)
+            except Exception:
+                pass
         if claim_recs and self._fleet is None:
             # restarted OUTSIDE fleet mode with a claimed journal: no
             # lease/claim state to arbitrate with — conservatively
@@ -1111,9 +1171,10 @@ class Server:
         session FIRST (the intent record is what makes a kill -9
         mid-delete resumable — and only terminal sessions are ever
         journaled, so a live session can never be orphaned), then
-        delete its directories and drop it from the listing."""
+        delete its directories and drop it from the listing.  The
+        caching-tier half (:meth:`_gc_cache`) rides the same sweep."""
         if self.ttl_s <= 0:
-            return 0
+            return self._gc_cache()
         now = time.time()
         expired: List[Session] = []
         with self._lock:
@@ -1147,6 +1208,53 @@ class Server:
                     "mrtpu_serve_gc_total",
                     "expired sessions swept by the TTL GC",
                     ("tenant",)).inc(tenant=sess.tenant)
+            except Exception:
+                pass
+        return n + self._gc_cache()
+
+    def _gc_cache(self) -> int:
+        """Caching-tier half of the TTL sweep: memoized results past
+        ``MRTPU_MEMO_TTL`` (0 = keep forever), then CAS chunks with no
+        external hardlink untouched past ``MRTPU_CAS_GRACE``.  Each
+        batch journals its intent record (``memo_gc`` / ``cas_gc``)
+        BEFORE removing anything — a kill -9 mid-sweep finishes on
+        restart (_recover), and both finish halves are idempotent, so
+        a chunk re-referenced after the intent survives and a refcount
+        can never go negative."""
+        from ..utils.cas import cas_store
+        from . import memo as memo_mod
+        n = 0
+        try:
+            keys = memo_mod.sweep_candidates(self.memo_ttl_s) \
+                if self.memo_ttl_s > 0 else []
+            if keys:
+                with self._submit_lock:
+                    if self._journal is None:
+                        return n   # shutting down: next restart sweeps
+                    self._journal.append({"kind": "memo_gc",
+                                          "keys": keys})
+                n += memo_mod.sweep_finish(keys)
+            store = cas_store()
+            digests = store.gc_candidates(self.cas_grace_s) \
+                if store is not None else []
+            if digests:
+                with self._submit_lock:
+                    if self._journal is None:
+                        return n
+                    self._journal.append({"kind": "cas_gc",
+                                          "digests": digests})
+                n += store.gc_finish(digests)
+        except Exception:
+            return n          # cache GC must never take the daemon down
+        if n:
+            with self._lock:
+                self.cache_gc_count += n
+            try:
+                from ..obs.metrics import get_registry
+                get_registry().counter(
+                    "mrtpu_cas_gc_total",
+                    "caching-tier entries swept (expired memo records "
+                    "+ unreferenced CAS chunks)").inc(n)
             except Exception:
                 pass
         return n
@@ -1231,6 +1339,24 @@ class Server:
             # journal closed — the missing done record only costs one
             # redundant (idempotent) replay on the next restart
             try:
+                meta = {}
+                try:
+                    meta = result.get("meta") or {}
+                except NameError:
+                    pass
+                memo_meta = meta.get("memo") or {}
+                if memo_meta.get("hit"):
+                    # durable proof the session was memo-served: a
+                    # kill -9 replay sees cache_hit+serve_done and
+                    # re-serves from the store — never recomputes.
+                    # mrlint: disable=lock-unguarded-mutation —
+                    # documented drain race (comment above): a closed
+                    # journal costs one idempotent replay;
+                    # Journal.append has its own write lock
+                    self._journal.append({"kind": "cache_hit",
+                                          "sid": sess.sid,
+                                          "key": memo_meta.get("key"),
+                                          "trace": sess.trace_id})
                 # mrlint: disable=lock-unguarded-mutation — documented
                 # drain race (comment above): a closed journal costs
                 # one idempotent replay; Journal.append has its own
@@ -1442,6 +1568,22 @@ class Server:
             return 200, {**sess.summary(),
                          "error": sess.error or "result file unavailable"}
 
+    def _cache_stats(self) -> dict:
+        """The caching-tier section of /v1/stats (mrctl cache): CAS
+        store shape, memoization counters, and sweep totals."""
+        from ..utils.cas import cas_store
+        from . import memo as memo_mod
+        store = cas_store()
+        cas = store.stats() if store is not None \
+            else {"enabled": 0, "chunks": 0, "bytes": 0}
+        with self._lock:
+            swept = self.cache_gc_count
+        return {"cas": cas,
+                "memo": memo_mod.memo_stats(),
+                "gc": {"memo_ttl_s": self.memo_ttl_s,
+                       "cas_grace_s": self.cas_grace_s,
+                       "swept": swept}}
+
     def stats(self) -> dict:
         from ..plan.cache import cache_stats
         with self._lock:
@@ -1466,6 +1608,7 @@ class Server:
                 "gc": {"ttl_s": self.ttl_s, "swept": self.gc_count},
                 "mesh": self._mesh_status(),
                 "plan": cache_stats(),
+                "cache": self._cache_stats(),
                 # the self-protection plane (doc/serve.md): auth arming,
                 # shed/deprioritize counts, cost evidence, disk
                 # pressure, watchdog and autoscaler state

@@ -206,6 +206,50 @@ def atomic_write_json(path: str, obj: dict) -> None:
     os.replace(tmp, path)
 
 
+def _serve_memoized(server, sess: Session, mkey: str,
+                    prior: dict) -> dict:
+    """Serve one session from the memo store (serve/memo.py): the
+    stored output/files/mrs verbatim — byte-identical to the recompute
+    by the exactness contract — with 0 plan compiles, 0 dispatches and
+    0 MR ops executed.  The worker loop sees ``meta.memo.hit`` and
+    journals a ``cache_hit`` record next to the ``serve_done``."""
+    from ..obs import context as obs_context
+    t0 = time.perf_counter()
+    if not sess.trace_id:
+        sess.trace_id = obs_context.new_trace_id()
+    sess.resumed = False
+    prior_meta = prior.get("meta") or {}
+    result = {
+        "id": sess.sid, "tenant": sess.tenant, "status": DONE,
+        "error": None,
+        "output": prior.get("output", ""),
+        "files": prior.get("files", {}),
+        "mrs": prior.get("mrs", {}),
+        "meta": {
+            "wall_s": None,           # stamped below (routing+verify)
+            "trace_id": sess.trace_id,
+            "resumed": False,
+            "resharded": False,
+            "failed_over": sess.failed_over,
+            "cancel_reason": None,
+            "deadline_ms": sess.deadline_ms,
+            "mesh_width": sess.mesh_width,
+            "dispatches": 0,
+            "plan_cache": {"plan": {"hits": 0, "misses": 0}},
+            "pages": {},
+            "profile": {"dispatches": 0},
+            "memo": {"hit": True, "key": mkey,
+                     "source_wall_s": prior_meta.get("wall_s"),
+                     "source_trace_id": prior_meta.get("trace_id")},
+        },
+    }
+    sess.wall_s = round(time.perf_counter() - t0, 6)
+    result["meta"]["wall_s"] = sess.wall_s
+    atomic_write_json(server.result_path(sess.sid), result)
+    sess.state = DONE
+    return result
+
+
 def run_session(server, sess: Session) -> dict:
     """Execute one session on a worker thread; returns (and durably
     writes) the result record.  Never raises — a failing script is a
@@ -222,12 +266,25 @@ def run_session(server, sess: Session) -> dict:
     from ..obs import context as obs_context
     from ..oink.objects import ObjectManager
     from ..oink.script import OinkScript
+    from . import memo as memo_mod
 
     sdir = server.session_dir(sess.sid)
     outdir = os.path.join(sdir, "out")
     spill = os.path.join(sdir, "spill")
     os.makedirs(outdir, exist_ok=True)
     os.makedirs(spill, exist_ok=True)
+
+    # result memoization (serve/memo.py): a previously-seen submission
+    # — same script bytes, same input-file bytes — serves the stored,
+    # integrity-verified result without executing anything.  Checked
+    # BEFORE the resume probe on purpose: a failed-over or replayed
+    # session whose payload a peer already computed is also a hit.
+    mkey = memo_mod.memo_key(sess.payload) \
+        if memo_mod.memoize_enabled() else None
+    if mkey is not None:
+        prior = memo_mod.lookup(mkey)
+        if prior is not None:
+            return _serve_memoized(server, sess, mkey, prior)
 
     screen = _CappedScreen()
     # mesh autoscaling (serve/autoscale.py): the daemon may hand this
@@ -406,8 +463,18 @@ def run_session(server, sess: Session) -> dict:
             "plan_cache": plan_delta,
             "pages": acct.snapshot(),
             "profile": profile,
+            "memo": {"hit": False, "key": mkey},
         },
     }
+    # memoize a clean fresh run: byte-identical resubmissions anywhere
+    # in the fleet are served from this record (serve/memo.py).  Resumed
+    # sessions are excluded — their output may reflect a partial replay
+    # boundary, and the contract is "what a fresh run produces".
+    if mkey is not None and status == DONE and not sess.resumed:
+        try:
+            memo_mod.store(mkey, result, writer=getattr(server, "rid", ""))
+        except Exception:
+            pass
     # the durable result lands BEFORE the state flips: a client polling
     # at 50 ms must never observe state=done while the result file is
     # still unwritten (it would read a bogus "result file unavailable"

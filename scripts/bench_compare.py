@@ -95,6 +95,14 @@ ADVISORY_METRICS = (
     # noisy; the attribution correctness invariants live in
     # tests/test_obsdist.py
     ("obs_dist_overhead_pct", -1),
+    # caching-tier rows (bench.py --cache, detail.cache_ab): wall of
+    # the warm-store restart submit (served from the memo store) and
+    # of the store-off baseline restart — advisory because tiny-daemon
+    # walls are noisy; the hard invariants (memo hit, 0 plan compiles,
+    # 0 dispatches, byte-exactness, corruption fallback) live in
+    # tests/test_memo.py and tests/test_cas.py
+    ("cache_warm_restart_sec", -1),
+    ("cache_result_hit_sec", -1),
 )
 
 DEFAULT_WINDOW = 3
@@ -187,6 +195,18 @@ def record_metrics(rec: dict) -> Optional[dict]:
     oab = det.get("obs_dist_ab") or {}
     if not oab.get("error") and oab.get("overhead_pct") is not None:
         m["obs_dist_overhead_pct"] = oab["overhead_pct"]
+    cab = det.get("cache_ab") or {}
+    son = cab.get("store_on") or {}
+    if not cab.get("error") and son:
+        w = (son.get("restart") or {}).get("wall_s")
+        if w is not None:
+            # the warm-store restart submit, end to end
+            m["cache_warm_restart_sec"] = w
+            if son.get("result_hit"):
+                # the same wall, but only when the restart was a
+                # VERIFIED memo hit (0 compiles, 0 dispatches) — the
+                # series breaks if the hit path ever stops firing
+                m["cache_result_hit_sec"] = w
     el = det.get("elastic") or {}
     if not el.get("error"):
         walls = [v for k, v in el.items()

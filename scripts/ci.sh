@@ -20,6 +20,9 @@
 #   scripts/ci.sh obsdist  # fleet observability subset (sync observer/
 #                          # federation units + stitched-trace golden,
 #                          # straggler attribution, federation chaos)
+#   scripts/ci.sh cache    # caching-tier subset (CAS/memo units +
+#                          # warm-restart/fleet hits, corruption
+#                          # fallback, GC intent replay)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -159,6 +162,33 @@ run_obsdist_subset_full() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_cache_subset_quick() {
+  echo "== caching-tier subset (fast): CAS store units + memo key/verify =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_cas.py tests/test_memo.py -q \
+      -m 'not slow' -k 'not fleet and not restart and not exactness' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_cache_subset_full() {
+  echo "== caching-tier subset (full): warm-restart/fleet memo hits, corruption fallback, GC replay =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_cas.py tests/test_memo.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_apps_subset_quick() {
+  echo "== apps subset (fast): invertedindex + graph commands, sans goldens =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_invertedindex.py \
+      tests/test_graph_commands.py -q \
+      -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_apps_subset_full() {
+  echo "== apps subset (full): multi-batch corpus + mesh stays-on-device goldens =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_invertedindex.py \
+      tests/test_graph_commands.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_fleet_subset_quick() {
   echo "== fleet subset (fast): lease/claim/ring units + router + satellites =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
@@ -202,6 +232,18 @@ if [ "${1:-}" = "obsdist" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "cache" ]; then
+  run_cache_subset_quick
+  run_cache_subset_full
+  exit 0
+fi
+
+if [ "${1:-}" = "apps" ]; then
+  run_apps_subset_quick
+  run_apps_subset_full
+  exit 0
+fi
+
 if [ "${1:-}" = "quick" ]; then
   run_lint_quick
   run_plan_subset
@@ -213,6 +255,7 @@ if [ "${1:-}" = "quick" ]; then
   run_fleet_subset_quick
   run_dist_subset_quick
   run_obsdist_subset_quick
+  run_cache_subset_quick
   run_context_subset
   run_elastic_subset_quick
   run_wire_subset_quick
@@ -241,6 +284,7 @@ run_overload_subset_full
 run_fleet_subset_full
 run_dist_subset_full
 run_obsdist_subset_full
+run_cache_subset_full
 run_context_subset
 run_elastic_subset_full
 run_wire_subset_full

@@ -12,6 +12,7 @@
     mrctl.py [...] watch SID [--timeout SECS]   # stream /events (no poll)
     mrctl.py [...] slo
     mrctl.py [...] stats
+    mrctl.py [...] cache [--json]               # caching-tier view
     mrctl.py [...] top [--watch SECS] [--json]  # fleet member live view
     mrctl.py [...] drain
     mrctl.py [...] shutdown
@@ -103,6 +104,42 @@ def _top_table(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def _cache_table(doc: dict) -> str:
+    """The ``mrctl cache`` view: one line per caching tier
+    (doc/perf.md#the-caching-tier) — hit ratios, store size, GC
+    counts — distilled from the daemon's /v1/stats record."""
+    cache = doc.get("cache") or {}
+    cas = cache.get("cas") or {}
+    memo = cache.get("memo") or {}
+    gc = cache.get("gc") or {}
+    plan = (doc.get("plan") or {}).get("persistent") or {}
+
+    def ratio(h, m):
+        return f"{h / (h + m):.2f}" if (h + m) else "-"
+
+    return "\n".join([
+        f"cas   enabled={cas.get('enabled', 0)} "
+        f"chunks={cas.get('chunks', 0)} bytes={cas.get('bytes', 0)} "
+        f"dedup_hits={cas.get('dedup_hits', 0)} "
+        f"quarantined={cas.get('quarantined', 0)}",
+        f"plan  enabled={plan.get('enabled', 0)} "
+        f"entries={plan.get('entries', 0)} "
+        f"bytes={plan.get('bytes', 0)} "
+        f"hit_ratio={ratio(plan.get('hits', 0), plan.get('misses', 0))} "
+        f"evictions={plan.get('evictions', 0)}",
+        f"memo  enabled={memo.get('enabled', 0)} "
+        f"entries={memo.get('entries', 0)} "
+        f"bytes={memo.get('bytes', 0)} "
+        f"hit_ratio={ratio(memo.get('hits', 0), memo.get('misses', 0))} "
+        f"corrupt={memo.get('corrupt', 0)}",
+        f"gc    swept={gc.get('swept', 0)} "
+        f"chunks_removed={cas.get('gc_removed', 0)} "
+        f"bytes_reclaimed={cas.get('gc_bytes', 0)} "
+        f"memo_ttl_s={gc.get('memo_ttl_s', 0)} "
+        f"cas_grace_s={gc.get('cas_grace_s', 0)}",
+    ])
+
+
 def _terminal_code(r: dict) -> int:
     """0 done, 5 failed, 7 cancelled — one mapping for every verb that
     reports a terminal session."""
@@ -163,6 +200,10 @@ def main(argv=None) -> int:
                          "reached a terminal state by then")
     sub.add_parser("slo")
     sub.add_parser("stats")
+    cc = sub.add_parser("cache", help="caching-tier hit ratios, store "
+                                      "size and GC counts")
+    cc.add_argument("--json", action="store_true",
+                    help="print the raw cache + plan stats sections")
     tp = sub.add_parser("top", help="fleet-wide member table from the "
                                     "router's /metrics/fleet.json")
     tp.add_argument("--watch", type=float, default=0.0, metavar="SECS",
@@ -248,6 +289,13 @@ def main(argv=None) -> int:
             print(json.dumps(c.slo(), indent=2))
         elif args.cmd == "stats":
             print(json.dumps(c.stats(), indent=2))
+        elif args.cmd == "cache":
+            doc = c.stats()
+            if args.json:
+                print(json.dumps({"cache": doc.get("cache"),
+                                  "plan": doc.get("plan")}, indent=2))
+            else:
+                print(_cache_table(doc))
         elif args.cmd == "top":
             import time as _time
             while True:

@@ -1,0 +1,275 @@
+"""Content-addressed store tests (utils/cas.py + plan/cache.py's
+persistent tier): chunk put/get/verify, hardlink-refcount dedup,
+grace-window GC with idempotent journaled finish, stable plan digests,
+and the on-disk plan cache that survives restarts (doc/perf.md, "The
+caching tier")."""
+
+import json
+import os
+
+import pytest
+
+from gpu_mapreduce_tpu.utils.cas import (CASStore, cas_enabled, cas_root,
+                                         cas_store, reset_store,
+                                         sha256_bytes, sha256_file)
+
+
+def _integrity_count(artifact: str) -> int:
+    from gpu_mapreduce_tpu.obs.metrics import get_registry
+    return get_registry().counter(
+        "mrtpu_integrity_failures_total", "", ("artifact",)
+    ).value(artifact=artifact)
+
+
+@pytest.fixture
+def store(tmp_path):
+    return CASStore(str(tmp_path / "cas"))
+
+
+# ---------------------------------------------------------------------------
+# chunk store units
+# ---------------------------------------------------------------------------
+
+def test_put_get_roundtrip(store):
+    data = b"the quick brown fox" * 100
+    digest = store.put_bytes(data)
+    assert digest == sha256_bytes(data)
+    assert store.contains(digest)
+    assert store.get_bytes(digest) == data
+    # second put of the same bytes is a dedup hit, not a rewrite
+    before = os.path.getmtime(store._opath(digest))
+    assert store.put_bytes(data) == digest
+    assert os.path.getmtime(store._opath(digest)) == before
+    assert store.dedup_hits >= 1
+
+
+def test_missing_chunk_reads_none(store):
+    assert store.get_bytes("0" * 64) is None
+    assert not store.contains("0" * 64)
+    assert store.refcount("0" * 64) == 0
+
+
+def test_corrupt_chunk_quarantined_and_counted(store):
+    digest = store.put_bytes(b"payload bytes")
+    path = store._opath(digest)
+    raw = bytearray(open(path, "rb").read())
+    raw[0] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(raw)
+    before = _integrity_count("cas")
+    # verified read: mismatch → None, never the flipped bytes
+    assert store.get_bytes(digest) is None
+    assert _integrity_count("cas") == before + 1
+    assert not store.contains(digest)          # quarantined away
+    assert store.quarantined == 1
+
+
+def test_adopt_and_dedup_share_inodes(store, tmp_path):
+    a = tmp_path / "a.bin"
+    b = tmp_path / "b.bin"
+    a.write_bytes(b"same chunk content")
+    b.write_bytes(b"same chunk content")
+    da = store.dedup_file(str(a))
+    db = store.dedup_file(str(b))
+    assert da == db == sha256_file(str(a))
+    # both files now hardlink the one stored object
+    assert os.stat(a).st_ino == os.stat(b).st_ino \
+        == os.stat(store._opath(da)).st_ino
+    assert store.refcount(da) == 2
+    assert a.read_bytes() == b"same chunk content"
+
+
+def test_materialize_links_and_releases(store, tmp_path):
+    digest = store.put_bytes(b"spill page")
+    dest = tmp_path / "restored.bin"
+    assert store.materialize(digest, str(dest))
+    assert dest.read_bytes() == b"spill page"
+    assert store.refcount(digest) == 1
+    # releasing a reference is just unlinking the caller's own link:
+    # the count can never go negative, it is the link count itself
+    os.remove(dest)
+    assert store.refcount(digest) == 0
+    assert not store.materialize("f" * 64, str(tmp_path / "nope"))
+
+
+# ---------------------------------------------------------------------------
+# GC: grace window, re-reference safety, idempotent finish
+# ---------------------------------------------------------------------------
+
+def test_gc_grace_window_and_referenced_chunks_survive(store, tmp_path):
+    ref = tmp_path / "kept.bin"
+    ref.write_bytes(b"referenced")
+    dref = store.dedup_file(str(ref))           # nlink 2: referenced
+    dorp = store.put_bytes(b"orphan")           # nlink 1: orphaned
+    now = os.path.getmtime(store._opath(dorp)) + 10.0
+    # inside the grace window nothing is a candidate
+    assert store.gc_candidates(grace_s=3600.0, now=now) == []
+    cands = store.gc_candidates(grace_s=1.0, now=now)
+    assert cands == [dorp]                      # referenced chunk exempt
+    assert store.gc_finish(cands) == 1
+    assert not store.contains(dorp)
+    assert store.contains(dref)
+
+
+def test_gc_finish_idempotent_and_rereference_safe(store, tmp_path):
+    dorp = store.put_bytes(b"short lived")
+    now = os.path.getmtime(store._opath(dorp)) + 10.0
+    cands = store.gc_candidates(grace_s=1.0, now=now)
+    assert cands == [dorp]
+    # a reference taken AFTER the intent was journaled: finish re-stats
+    # and skips — the chunk survives
+    out = tmp_path / "taken.bin"
+    assert store.materialize(dorp, str(out))
+    assert store.gc_finish(cands) == 0
+    assert store.contains(dorp)
+    os.remove(out)
+    assert store.gc_finish(cands) == 1          # now truly unreferenced
+    # replaying the same intent (kill -9 recovery) is a no-op
+    assert store.gc_finish(cands) == 0
+    assert store.refcount(dorp) == 0
+
+
+def test_stats_shape(store):
+    store.put_bytes(b"x")
+    store.put_bytes(b"y" * 1000)
+    st = store.stats()
+    assert st["enabled"] == 1 and st["chunks"] == 2
+    assert st["bytes"] >= 1001
+    for k in ("dedup_hits", "stores", "reads", "quarantined",
+              "gc_removed", "gc_bytes"):
+        assert k in st
+
+
+# ---------------------------------------------------------------------------
+# singleton wiring (env-driven, like every other tier)
+# ---------------------------------------------------------------------------
+
+def test_cas_root_resolution(tmp_path, monkeypatch):
+    monkeypatch.delenv("MRTPU_CAS_DIR", raising=False)
+    monkeypatch.delenv("MRTPU_FLEET_DIR", raising=False)
+    assert cas_root() is None and not cas_enabled()
+    monkeypatch.setenv("MRTPU_FLEET_DIR", str(tmp_path / "fleet"))
+    assert cas_root() == str(tmp_path / "fleet" / "cas")
+    monkeypatch.setenv("MRTPU_CAS_DIR", str(tmp_path / "cas"))
+    assert cas_root() == str(tmp_path / "cas")   # explicit dir wins
+    monkeypatch.setenv("MRTPU_CAS", "0")
+    assert not cas_enabled()                     # one-knob kill switch
+
+
+def test_cas_store_singleton_reroots(tmp_path, monkeypatch):
+    reset_store()
+    try:
+        monkeypatch.setenv("MRTPU_CAS_DIR", str(tmp_path / "one"))
+        s1 = cas_store()
+        assert s1 is not None and s1 is cas_store()
+        monkeypatch.setenv("MRTPU_CAS_DIR", str(tmp_path / "two"))
+        s2 = cas_store()
+        assert s2 is not s1 and s2.root == str(tmp_path / "two")
+        monkeypatch.setenv("MRTPU_CAS", "0")
+        assert cas_store() is None
+    finally:
+        reset_store()
+
+
+# ---------------------------------------------------------------------------
+# stable plan digests + payload serialization
+# ---------------------------------------------------------------------------
+
+def test_stable_plan_digest_stability():
+    from gpu_mapreduce_tpu.plan.cache import stable_plan_digest
+    key = ("fp123", ("sig", 4), ("serial",), "xla", False, True)
+    d1 = stable_plan_digest(key)
+    d2 = stable_plan_digest(("fp123", ("sig", 4), ("serial",), "xla",
+                             False, True))
+    assert d1 == d2 and len(d1) == 64
+    assert stable_plan_digest(key) != stable_plan_digest(
+        ("fp124",) + key[1:])
+    # function components render by qualified name (stable across
+    # processes), unstatable components make the plan process-local
+    fkey = (("fn", sha256_bytes),)
+    assert stable_plan_digest(fkey) == stable_plan_digest(fkey)
+    assert stable_plan_digest((object(),)) is None
+
+
+def test_plan_payload_jsonable_roundtrip():
+    import numpy as np
+    from gpu_mapreduce_tpu.plan.cache import from_jsonable, to_jsonable
+    val = ("wire", (1, 2, (3, "u32")), np.int32(7), 2.5, None)
+    back = from_jsonable(json.loads(json.dumps(to_jsonable(val))))
+    assert back == ("wire", (1, 2, (3, "u32")), 7, 2.5, None)
+    assert isinstance(back, tuple) and isinstance(back[1], tuple)
+    with pytest.raises(TypeError):
+        to_jsonable(object())
+
+
+def test_persistent_plan_cache_roundtrip(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.plan.cache import PersistentPlanCache
+    pp = PersistentPlanCache(str(tmp_path))
+    payload = {"caps": {"0": ["wire", [1, 2]]}, "mega": {}}
+    assert pp.store("d" * 64, payload)
+    assert not pp.store("d" * 64, payload)       # unchanged → no write
+    assert pp.load("d" * 64) == payload
+    assert pp.load("e" * 64) is None
+    st = pp.stats()
+    assert st["entries"] == 1 and st["hits"] == 1 and st["misses"] == 1
+
+
+def test_persistent_plan_cache_corruption_degrades(tmp_path):
+    from gpu_mapreduce_tpu.plan.cache import PersistentPlanCache
+    pp = PersistentPlanCache(str(tmp_path))
+    pp.store("a" * 64, {"caps": {}, "mega": {}})
+    path = pp._path("a" * 64)
+    raw = open(path).read().replace('"caps"', '"craps"', 1)
+    with open(path, "w") as f:
+        f.write(raw)
+    before = _integrity_count("cas")
+    assert pp.load("a" * 64) is None             # miss, never bad state
+    assert _integrity_count("cas") == before + 1
+    assert not os.path.exists(path)              # removed
+
+
+def test_persistent_plan_cache_cap_evicts_oldest(tmp_path, monkeypatch):
+    from gpu_mapreduce_tpu.plan.cache import PersistentPlanCache
+    monkeypatch.setenv("MRTPU_PLAN_PERSIST_CAP", "2")
+    pp = PersistentPlanCache(str(tmp_path))
+    for i, d in enumerate(("a" * 64, "b" * 64, "c" * 64)):
+        pp.store(d, {"caps": {}, "mega": {}, "n": i})
+        os.utime(pp._path(d), (1000.0 + i, 1000.0 + i))
+    pp.store("d" * 64, {"caps": {}, "mega": {}, "n": 3})
+    st = pp.stats()
+    assert st["entries"] == 2 and st["evictions"] >= 2
+    assert pp.load("a" * 64) is None             # oldest went first
+
+
+# ---------------------------------------------------------------------------
+# the restart path: a cleared in-memory cache refills from disk
+# ---------------------------------------------------------------------------
+
+def test_plan_persist_restart_refills_from_disk(tmp_path, monkeypatch):
+    """The rung-(a) smoke: run a fused script, clear the in-memory plan
+    LRU (a restart's cold cache), run again — the persistent tier
+    serves every plan digest instead of recompiling cold."""
+    from gpu_mapreduce_tpu.oink.script import OinkScript
+    from gpu_mapreduce_tpu.plan.cache import persistent_cache, plan_cache
+    monkeypatch.setenv("MRTPU_CAS_DIR", str(tmp_path / "cas"))
+    monkeypatch.setenv("MRTPU_JIT_PERSIST", "0")
+    reset_store()
+    plan_cache().clear()
+    try:
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("alpha beta gamma alpha beta alpha\n" * 50)
+        script = (f"variable files index {corpus}\nset fuse 1\n"
+                  f"wordfreq 3 -i v_files\n")
+        OinkScript(screen=False).run_string(script)
+        pp = persistent_cache()
+        assert pp is not None
+        first = pp.stats()
+        assert first["entries"] > 0              # this run persisted
+        plan_cache().clear()                     # "restart"
+        OinkScript(screen=False).run_string(script)
+        second = pp.stats()
+        assert second["hits"] > first["hits"]    # disk tier rescued
+        assert second["entries"] == first["entries"]  # no rewrite churn
+    finally:
+        plan_cache().clear()
+        reset_store()

@@ -139,6 +139,7 @@ def test_cc_find_on_mesh_backend(graph_file, tmp_path):
     assert cmd.ncc == len(set(oracle.values()))
 
 
+@pytest.mark.slow
 def test_cc_find_mesh_stays_on_device(tmp_path, monkeypatch):
     """VERDICT r1 #3 'done' criterion: the COMPOSED cc_find engine's
     iteration loop on the mesh backend must never materialise a frame on
@@ -205,6 +206,7 @@ def _spy_snapshots(module, kernel_name):
     return snaps, lambda: setattr(module, kernel_name, orig)
 
 
+@pytest.mark.slow
 def test_luby_mesh_stays_on_device(graph_file, tmp_path, monkeypatch):
     """Pins the COMPOSED engine's device tier (the default fused engine
     is one dispatch for the whole loop — trivially on-device)."""
@@ -224,6 +226,7 @@ def test_luby_mesh_stays_on_device(graph_file, tmp_path, monkeypatch):
     assert snaps[-1] == snaps[0], f"host materialisation in loop: {snaps}"
 
 
+@pytest.mark.slow
 def test_sssp_mesh_stays_on_device(tmp_path, rng, monkeypatch):
     """Pins the COMPOSED engine's device tier (the default fused engine
     is one dispatch for the whole loop — trivially on-device)."""

@@ -182,6 +182,7 @@ def test_long_url_dense_corpus_wide_fallback(tmp_path):
     assert sorted(set(ii.urls.values())) == sorted(set(urls))
 
 
+@pytest.mark.slow
 def test_multi_batch_corpus(html_corpus, monkeypatch):
     """Force the per-corpus byte cap below one file so every file becomes
     its own batch — counts and url dict must match the single-batch run."""

@@ -339,6 +339,45 @@ def test_cachekey_lru_cache_builder(tmp_path):
     assert "lru_cache" in live[0].msg
 
 
+CACHEKEY_CAS_BAD = """
+    import hashlib
+    import os
+
+    def memo_key(payload):
+        mode = os.environ.get("MRTPU_MODE", "1")   # changes the result...
+        if mode == "0":
+            payload = payload.upper()
+        return hashlib.sha256(payload.encode()).hexdigest()
+"""
+
+CACHEKEY_CAS_CLEAN = """
+    import hashlib
+    import os
+
+    def memo_key(payload):
+        mode = os.environ.get("MRTPU_MODE", "1")
+        return hashlib.sha256(
+            (payload + mode).encode()).hexdigest()  # knob IS keyed
+"""
+
+
+def test_cachekey_cas_builder_env_read_flagged(tmp_path):
+    # idiom 3: a content-address key builder (*_key/*_digest around a
+    # hashing call) whose reachable env knob never feeds the digest —
+    # two stores could silently share one key across knob states
+    _, live = run_fixture(str(tmp_path), {"mod.py": CACHEKEY_CAS_BAD},
+                          ["cache-key"])
+    assert len(live) == 1
+    assert live[0].rule == "cache-key-missing-knob"
+    assert "MRTPU_MODE" in live[0].msg
+
+
+def test_cachekey_cas_builder_clean_when_knob_keyed(tmp_path):
+    _, live = run_fixture(str(tmp_path), {"mod.py": CACHEKEY_CAS_CLEAN},
+                          ["cache-key"])
+    assert live == []
+
+
 # ---------------------------------------------------------------------------
 # knob-registry
 # ---------------------------------------------------------------------------

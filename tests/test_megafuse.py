@@ -195,6 +195,7 @@ def test_megafuse_golden_wire_modes(monkeypatch, wire):
     assert eager == fused_warm
 
 
+@pytest.mark.slow
 def test_megafuse_golden_pallas_forced_matches_sort(monkeypatch):
     """MRTPU_PALLAS_GROUP=1 (the table kernels, interpret mode on this
     CPU) produces results identical to the sort path, warm and cold."""
@@ -265,6 +266,7 @@ def test_single_dispatch_per_pipeline(monkeypatch, wire):
     assert n1 == n2
 
 
+@pytest.mark.slow
 def test_single_dispatch_with_pallas_kernels(monkeypatch):
     """Still exactly 1 dispatch with the table kernels forced on: the
     paged pallas_calls ride the single megafused jit program (the

@@ -1,0 +1,417 @@
+"""Result memoization tests (serve/memo.py + the daemon wiring): key
+exactness contract, durable hits across daemon restarts and replica
+death, corruption degrading to recompute (never a wrong result),
+byte-exactness vs fresh recompute across fuse/wire/mesh-width, and the
+journaled-intent cache GC (doc/serve.md, "Result memoization")."""
+
+import json
+import os
+import time
+
+import pytest
+
+from gpu_mapreduce_tpu.serve import ServeClient, Server
+from gpu_mapreduce_tpu.serve import memo
+from gpu_mapreduce_tpu.utils.cas import cas_store, reset_store
+
+
+def _integrity_count(artifact: str) -> int:
+    from gpu_mapreduce_tpu.obs.metrics import get_registry
+    return get_registry().counter(
+        "mrtpu_integrity_failures_total", "", ("artifact",)
+    ).value(artifact=artifact)
+
+
+def write_corpus(path, words, repeat):
+    path.write_text((" ".join(words) + " ") * repeat)
+    return str(path)
+
+
+def wf_script(corpus, top=3, fuse=False):
+    lines = [f"variable files index {corpus}"]
+    if fuse:
+        lines.append("set fuse 1")
+    lines.append(f"wordfreq {top} -i v_files")
+    return "\n".join(lines) + "\n"
+
+
+def ii_script(*files):
+    return (f"variable files index {' '.join(files)}\n"
+            f"invertedindex -i v_files\n")
+
+
+def write_html(path, urls):
+    path.write_text(" ".join(f'<a href="{u}"> text' for u in urls))
+    return str(path)
+
+
+@pytest.fixture
+def cas_env(tmp_path, monkeypatch):
+    """One isolated CAS root per test; singletons re-rooted, counters
+    zeroed, plan LRU cold on entry and on exit."""
+    from gpu_mapreduce_tpu.plan.cache import plan_cache
+    monkeypatch.setenv("MRTPU_CAS_DIR", str(tmp_path / "cas"))
+    monkeypatch.setenv("MRTPU_JIT_PERSIST", "0")
+    reset_store()
+    memo.reset_counts()
+    plan_cache().clear()
+    yield str(tmp_path / "cas")
+    plan_cache().clear()
+    reset_store()
+
+
+def serve_one(tmp_path, name, script, **kw):
+    """Run one submission through a fresh daemon; returns the result."""
+    srv = Server(port=0, workers=1, queue_cap=8,
+                 state_dir=str(tmp_path / name), **kw)
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        return c.wait(c.submit(script=script)["id"])
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# key/manifest units
+# ---------------------------------------------------------------------------
+
+def test_memo_key_tracks_script_and_input_bytes(tmp_path, cas_env):
+    corpus = write_corpus(tmp_path / "c.txt", ["a", "b"], 10)
+    k1 = memo.memo_key(wf_script(corpus))
+    assert k1 is not None and k1 == memo.memo_key(wf_script(corpus))
+    assert memo.memo_key(wf_script(corpus, top=5)) != k1
+    with open(corpus, "a") as f:
+        f.write("extra ")
+    assert memo.memo_key(wf_script(corpus)) != k1   # input bytes moved
+
+
+def test_memo_key_excludes_perf_knobs(tmp_path, cas_env, monkeypatch):
+    """The exactness contract: fuse/wire/megafuse/mesh-width change HOW
+    a result is computed, never WHAT — none of them may enter the key."""
+    corpus = write_corpus(tmp_path / "c.txt", ["a", "b"], 10)
+    base = memo.memo_key(wf_script(corpus))
+    for knob in ("MRTPU_FUSE", "MRTPU_WIRE", "MRTPU_MEGAFUSE"):
+        for v in ("0", "1"):
+            monkeypatch.setenv(knob, v)
+            assert memo.memo_key(wf_script(corpus)) == base
+        monkeypatch.delenv(knob)
+
+
+def test_non_memoizable_scripts(tmp_path, cas_env):
+    corpus = write_corpus(tmp_path / "c.txt", ["a"], 5)
+    # nondeterministic output / side-effectful commands
+    assert memo.memo_key(f"set timer 1\n{wf_script(corpus)}") is None
+    assert memo.memo_key(f"set verbosity 2\n{wf_script(corpus)}") is None
+    assert memo.memo_key("save foo /tmp/x\n") is None
+    assert memo.memo_key("load foo /tmp/x\n") is None
+    # directory input token: contents unenumerable at key time
+    assert memo.memo_key(f"variable files index {tmp_path}\n"
+                         f"wordfreq 3 -i v_files\n") is None
+
+
+def test_store_lookup_roundtrip_and_done_only(cas_env):
+    result = {"status": "done", "output": "x\n", "files": {}, "mrs": {},
+              "meta": {"wall_s": 0.1}}
+    key = "a" * 64
+    assert not memo.store(key, {**result, "status": "failed"})
+    assert memo.lookup(key) is None
+    assert memo.store(key, result, writer="r1")
+    assert memo.lookup(key) == result
+    st = memo.memo_stats()
+    assert st["stores"] == 1 and st["hits"] == 1
+
+
+def test_corrupt_record_reads_as_miss_and_counts(cas_env):
+    result = {"status": "done", "output": "x\n", "files": {}, "mrs": {}}
+    key = "b" * 64
+    memo.store(key, result)
+    path = memo._memo_path(key)
+    raw = open(path).read().replace("x\\n", "y\\n", 1)
+    with open(path, "w") as f:
+        f.write(raw)
+    before = _integrity_count("cas")
+    assert memo.lookup(key) is None              # never the flipped bytes
+    assert _integrity_count("cas") == before + 1
+    assert not os.path.exists(path)              # removed: next run stores
+    assert memo.memo_stats()["corrupt"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the acceptance golden: daemon restart serves a warm hit with 0 work
+# ---------------------------------------------------------------------------
+
+def test_warm_restart_serves_hit_zero_compiles_zero_ops(tmp_path,
+                                                        cas_env):
+    from gpu_mapreduce_tpu.plan.cache import plan_cache
+    corpus = write_corpus(tmp_path / "w.txt", ["to", "be", "or"], 40)
+    script = wf_script(corpus, fuse=True)
+    cold = serve_one(tmp_path, "a", script)
+    assert cold["status"] == "done"
+    assert cold["meta"]["memo"] == {"hit": False,
+                                    "key": memo.memo_key(script)}
+    # daemon restart: a NEW server instance, cold in-memory plan cache
+    plan_cache().clear()
+    warm = serve_one(tmp_path, "b", script)
+    assert warm["status"] == "done"
+    m = warm["meta"]["memo"]
+    assert m["hit"] and m["key"] == cold["meta"]["memo"]["key"]
+    assert m["source_wall_s"] == cold["meta"]["wall_s"]
+    # zero recompiles, zero MR ops: nothing executed at all
+    assert warm["meta"]["dispatches"] == 0
+    assert warm["meta"]["plan_cache"]["plan"] == {"hits": 0, "misses": 0}
+    # byte-exact: output, files and named MRs verbatim
+    for field in ("output", "files", "mrs"):
+        assert warm[field] == cold[field]
+
+
+def test_plan_persist_restart_rescues_without_memo(tmp_path, cas_env,
+                                                   monkeypatch):
+    """Rung (a) alone: with memoization off, a restarted daemon still
+    recompiles nothing — every plan digest loads from the disk tier."""
+    from gpu_mapreduce_tpu.plan.cache import plan_cache
+    monkeypatch.setenv("MRTPU_MEMOIZE", "0")
+    corpus = write_corpus(tmp_path / "w.txt", ["to", "be", "or"], 40)
+    script = wf_script(corpus, fuse=True)
+    cold = serve_one(tmp_path, "a", script)
+    plan_cache().clear()
+    warm = serve_one(tmp_path, "b", script)
+    assert warm["status"] == "done"
+    assert not warm["meta"]["memo"]["hit"]       # it really re-ran
+    assert warm["output"] == cold["output"]
+    pc = warm["meta"]["plan_cache"]
+    assert pc.get("persistent", {}).get("hits", 0) > 0
+    assert pc.get("persistent", {}).get("misses", 0) == 0
+
+
+def test_memo_opt_out_recomputes(tmp_path, cas_env, monkeypatch):
+    corpus = write_corpus(tmp_path / "w.txt", ["x", "y"], 20)
+    script = wf_script(corpus)
+    serve_one(tmp_path, "a", script)
+    monkeypatch.setenv("MRTPU_MEMOIZE", "0")
+    again = serve_one(tmp_path, "b", script)
+    assert again["status"] == "done"
+    assert "memo" not in again["meta"] or not again["meta"]["memo"]["hit"]
+
+
+# ---------------------------------------------------------------------------
+# fleet: A computes, A dies, B serves the verified hit
+# ---------------------------------------------------------------------------
+
+def test_fleet_peer_serves_hit_after_replica_death(tmp_path,
+                                                   monkeypatch):
+    from gpu_mapreduce_tpu.plan.cache import plan_cache
+    monkeypatch.delenv("MRTPU_CAS_DIR", raising=False)
+    monkeypatch.setenv("MRTPU_JIT_PERSIST", "0")
+    root = tmp_path / "fleet"
+    monkeypatch.setenv("MRTPU_FLEET_DIR", str(root))
+    reset_store()
+    memo.reset_counts()
+    plan_cache().clear()
+    try:
+        corpus = write_corpus(tmp_path / "w.txt", ["p", "q", "r"], 30)
+        script = wf_script(corpus, fuse=True)
+        a = Server(port=0, workers=1, fleet_dir=str(root),
+                   replica_id="a", lease_s=0.6, heartbeat_s=0.1)
+        a.start()
+        try:
+            ca = ServeClient.local(a.port)
+            cold = ca.wait(ca.submit(script=script)["id"])
+            assert cold["status"] == "done"
+        finally:
+            a.shutdown()                         # replica A is gone
+        plan_cache().clear()                     # B starts cold
+        b = Server(port=0, workers=1, fleet_dir=str(root),
+                   replica_id="b", lease_s=0.6, heartbeat_s=0.1)
+        b.start()
+        try:
+            cb = ServeClient.local(b.port)
+            warm = cb.wait(cb.submit(script=script)["id"])
+            assert warm["status"] == "done"
+            assert warm["meta"]["memo"]["hit"]
+            assert warm["meta"]["dispatches"] == 0
+            assert warm["output"] == cold["output"]
+            assert warm["files"] == cold["files"]
+        finally:
+            b.shutdown()
+    finally:
+        plan_cache().clear()
+        reset_store()
+
+
+# ---------------------------------------------------------------------------
+# corruption degrades to recompute — never a wrong result
+# ---------------------------------------------------------------------------
+
+def test_fleet_bit_flip_falls_back_to_recompute(tmp_path, cas_env):
+    corpus = write_corpus(tmp_path / "w.txt", ["m", "n", "o"], 30)
+    script = wf_script(corpus)
+    cold = serve_one(tmp_path, "a", script)
+    key = cold["meta"]["memo"]["key"]
+    path = memo._memo_path(key)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF                   # bit-flip the record
+    with open(path, "wb") as f:
+        f.write(raw)
+    before = _integrity_count("cas")
+    again = serve_one(tmp_path, "b", script)
+    assert again["status"] == "done"
+    assert not again["meta"]["memo"]["hit"]      # verified → recomputed
+    assert again["output"] == cold["output"]     # and still exact
+    assert _integrity_count("cas") == before + 1
+    # the recompute re-stored a good record: third time hits again
+    third = serve_one(tmp_path, "c", script)
+    assert third["meta"]["memo"]["hit"]
+    assert third["output"] == cold["output"]
+
+
+# ---------------------------------------------------------------------------
+# byte-exactness across the excluded knobs (wordfreq + invertedindex)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_memo_exactness_across_fuse_wire_mesh(tmp_path, cas_env,
+                                              monkeypatch):
+    """The contract the key exclusions rest on: every knob combination
+    recomputes the SAME bytes, so serving a memoized result under a
+    different fuse/wire/mesh-width state is indistinguishable from
+    recomputing — and a hit is in fact served across the change."""
+    from gpu_mapreduce_tpu.parallel.mesh import make_mesh
+    # distinct per-word counts: top-N tie order is not part of the
+    # determinism contract, so the fixture must not depend on it
+    corpus = str(tmp_path / "w.txt")
+    with open(corpus, "w") as f:
+        f.write(("aa " * 4 + "bb " * 3 + "cc " * 2 + "dd ") * 25)
+    html = [write_html(tmp_path / "h0.html",
+                       ["http://x.com/a", "http://y.com/b"]),
+            write_html(tmp_path / "h1.html", ["http://x.com/a"])]
+    for label, script in (("wf", wf_script(corpus)),
+                          ("ii", ii_script(*html))):
+        memoized = serve_one(tmp_path, f"{label}-base", script)
+        assert memoized["status"] == "done"
+        assert not memoized["meta"]["memo"]["hit"]
+        combos = [("0", "0", 1), ("0", "1", 1), ("1", "0", 1),
+                  ("1", "1", 1), ("1", "1", 2)]
+        for i, (fuse, wire, width) in enumerate(combos):
+            monkeypatch.setenv("MRTPU_FUSE", fuse)
+            monkeypatch.setenv("MRTPU_WIRE", wire)
+            comm = make_mesh(width) if width > 1 else None
+            # fresh recompute (memo off): byte-identical results
+            monkeypatch.setenv("MRTPU_MEMOIZE", "0")
+            fresh = serve_one(tmp_path, f"{label}-f{i}", script,
+                              comm=comm)
+            assert fresh["status"] == "done"
+            assert fresh["output"] == memoized["output"]
+            assert fresh["files"] == memoized["files"]
+            # memo on: the knob change does not mask the hit
+            monkeypatch.setenv("MRTPU_MEMOIZE", "1")
+            hit = serve_one(tmp_path, f"{label}-h{i}", script,
+                            comm=comm)
+            assert hit["meta"]["memo"]["hit"]
+            assert hit["output"] == memoized["output"]
+        for knob in ("MRTPU_FUSE", "MRTPU_WIRE", "MRTPU_MEMOIZE"):
+            monkeypatch.delenv(knob, raising=False)
+
+
+# ---------------------------------------------------------------------------
+# cache GC: TTL sweep with journaled intents, kill -9 replay
+# ---------------------------------------------------------------------------
+
+def test_memo_ttl_sweep_journals_intent(tmp_path, cas_env, monkeypatch):
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    monkeypatch.setenv("MRTPU_MEMO_TTL", "1")
+    monkeypatch.setenv("MRTPU_CAS_GRACE", "1")
+    corpus = write_corpus(tmp_path / "w.txt", ["s", "t"], 20)
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "st"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        res = c.wait(c.submit(script=wf_script(corpus))["id"])
+        key = res["meta"]["memo"]["key"]
+        path = memo._memo_path(key)
+        assert os.path.exists(path)
+        os.utime(path, (time.time() - 3600, time.time() - 3600))
+        assert srv._gc_once() >= 1
+        assert not os.path.exists(path)          # swept
+        kinds = [r["kind"] for r in read_journal(srv.state_dir)]
+        assert "memo_gc" in kinds                # intent preceded delete
+        assert srv.stats()["cache"]["gc"]["swept"] >= 1
+    finally:
+        srv.shutdown()
+
+
+def test_restart_finishes_interrupted_cache_gc(tmp_path, cas_env):
+    """Kill -9 between the intent record and the delete: the restarted
+    daemon finishes both sweep halves idempotently (refcounts by
+    hardlink count can never go negative, replay or not)."""
+    from gpu_mapreduce_tpu.ft.journal import Journal
+    state = str(tmp_path / "st")
+    memo.store("c" * 64, {"status": "done", "output": "old\n",
+                          "files": {}, "mrs": {}})
+    dorp = cas_store().put_bytes(b"orphaned chunk")
+    keep = cas_store().put_bytes(b"kept chunk")
+    dest = tmp_path / "ref.bin"
+    assert cas_store().materialize(keep, str(dest))  # externally linked
+    j = Journal(state, script_mode=True)
+    j.append({"kind": "memo_gc", "keys": ["c" * 64]})
+    # the intent names BOTH chunks — but `keep` gained a reference
+    # before the crash, so replay must spare it
+    j.append({"kind": "cas_gc", "digests": [dorp, keep]})
+    j.close()
+    srv = Server(port=0, workers=1, state_dir=state)
+    srv.start()                                  # _recover replays
+    try:
+        assert memo.lookup("c" * 64) is None
+        assert not cas_store().contains(dorp)
+        assert cas_store().contains(keep)
+        assert cas_store().refcount(keep) == 1
+        # a second restart replays the same intents: still a no-op
+        srv2 = Server(port=0, workers=1, state_dir=state)
+        srv2.start()
+        srv2.shutdown()
+        assert cas_store().contains(keep)
+    finally:
+        srv.shutdown()
+
+
+def test_memo_hit_journals_cache_hit_record(tmp_path, cas_env):
+    from gpu_mapreduce_tpu.ft.journal import read_journal
+    corpus = write_corpus(tmp_path / "w.txt", ["u", "v"], 20)
+    script = wf_script(corpus)
+    serve_one(tmp_path, "a", script)
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "b"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        res = c.wait(c.submit(script=script)["id"])
+        assert res["meta"]["memo"]["hit"]
+        recs = read_journal(srv.state_dir)
+        hits = [r for r in recs if r["kind"] == "cache_hit"]
+        assert len(hits) == 1
+        assert hits[0]["key"] == res["meta"]["memo"]["key"]
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# stats surfaces
+# ---------------------------------------------------------------------------
+
+def test_daemon_stats_cache_section(tmp_path, cas_env):
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "st"))
+    srv.start()
+    try:
+        doc = srv.stats()["cache"]
+        assert doc["cas"]["enabled"] == 1
+        assert set(doc["memo"]) >= {"enabled", "entries", "hits",
+                                    "misses", "stores", "corrupt"}
+        assert set(doc["gc"]) == {"memo_ttl_s", "cas_grace_s", "swept"}
+    finally:
+        srv.shutdown()
+
+
+def test_plan_cache_stats_has_persistent_section(cas_env):
+    from gpu_mapreduce_tpu.plan.cache import cache_stats
+    st = cache_stats()
+    assert "persistent" in st
+    assert st["persistent"]["enabled"] == 1
