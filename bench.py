@@ -317,6 +317,8 @@ WIRE_MODE = None   # --wire {0,1,ab} (or BENCH_WIRE): compressed-vs-raw
 #                    shuffle exchange A/B on the shuffle-bound workloads
 OBSDIST_MODE = False  # --obsdist (or BENCH_OBSDIST=1): 4-proc mrlaunch
 #                       wordfreq with sync-site instrumentation on vs off
+STREAM_MODE = False  # --stream (or BENCH_STREAM=1): incremental
+#                      standing-query vs one-shot A/B + batch cadence
 CACHE_MODE = None  # --cache {0,1,ab} (or BENCH_CACHE): cold-restart vs
 #                    warm-store caching-tier A/B (utils/cas.py)
 GATE = False       # --gate: after the run, regress-check against the
@@ -610,6 +612,53 @@ def serve_ab_record() -> dict:
     finally:
         if srv is not None:
             srv.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def stream_ab_record() -> dict:
+    """``--stream``: the standing-query A/B (stream/engine.py,
+    doc/streaming.md) — ingest the same corpus INCREMENTALLY (N
+    micro-batch commits, each paying the journal fsync + checkpoint
+    durability tax) vs ONE SHOT over the finished file, asserting the
+    snapshots are byte-identical and recording the steady-state batch
+    wall (p50 over the warm tail, the compiles amortized away) and the
+    sustained commit rate."""
+    import shutil
+    import tempfile
+    from gpu_mapreduce_tpu.stream import Stream
+    tmp = tempfile.mkdtemp(prefix="bench_stream_")
+    try:
+        src = os.path.join(tmp, "feed.txt")
+        nbatches = int(os.environ.get("BENCH_STREAM_BATCHES", "12"))
+        chunk = " ".join(f"w{i % 2048}" for i in range(20000)) + "\n"
+        s = Stream(os.path.join(tmp, "inc"), [src],
+                   settings={"fuse": 1})
+        walls = []
+        t0 = time.perf_counter()
+        for _ in range(nbatches):
+            with open(src, "a") as f:
+                f.write(chunk)
+            b0 = time.perf_counter()
+            s.drain()
+            walls.append(time.perf_counter() - b0)
+        inc_wall = time.perf_counter() - t0
+        inc_snap = s.snapshot()
+        s.close()
+        one = Stream(os.path.join(tmp, "one"), [src],
+                     settings={"fuse": 1})
+        b0 = time.perf_counter()
+        one.drain(final=True)
+        oneshot_wall = time.perf_counter() - b0
+        identical = one.snapshot() == inc_snap
+        one.close()
+        warm = sorted(walls[2:]) or sorted(walls)
+        return {"batches": nbatches,
+                "incremental_wall_s": round(inc_wall, 4),
+                "oneshot_wall_s": round(oneshot_wall, 4),
+                "batch_p50_ms": round(warm[len(warm) // 2] * 1000, 2),
+                "batches_per_sec": round(nbatches / inc_wall, 2),
+                "identical": identical}
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
@@ -1124,6 +1173,14 @@ def run_bench(engine, backend_err):
         except Exception:
             detail["cache_ab"] = {
                 "error": tb_tail(traceback.format_exc(), 3)[-300:]}
+    if STREAM_MODE:
+        # --stream: incremental standing-query vs one-shot A/B
+        # (stream/engine.py); failures must not cost the headline line
+        try:
+            detail["stream_ab"] = stream_ab_record()
+        except Exception:
+            detail["stream_ab"] = {
+                "error": tb_tail(traceback.format_exc(), 3)[-300:]}
     if os.environ.get("BENCH_PROFILE_AB", "1") != "0":
         # trace-context armed-vs-disarmed micro A/B (obs/context.py):
         # cheap (~seconds), recorded on every round so the advisory
@@ -1192,6 +1249,8 @@ def main():
         os.environ.get("BENCH_ELASTIC") == "1"
     OBSDIST_MODE = "--obsdist" in argv or \
         os.environ.get("BENCH_OBSDIST") == "1"
+    STREAM_MODE = "--stream" in argv or \
+        os.environ.get("BENCH_STREAM") == "1"
     backend_err = None
     try:
         probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))

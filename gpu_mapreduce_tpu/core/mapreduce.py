@@ -1205,6 +1205,24 @@ class MapReduce:
             mr.kmv.complete()
         return mr
 
+    def stream(self, sources, dir: str, parser: str = "words",
+               reduce: str = "count", **kw):
+        """Open a standing query whose resident dataset is THIS object
+        (stream/engine.py, doc/streaming.md): tail ``sources``
+        (append-only files/dirs), cut micro-batches, run the
+        ``parser``/``reduce`` chain on each delta and merge it here —
+        after every committed batch ``self`` holds the up-to-date
+        aggregate and ``self.kv`` reads it like any batch result.
+        ``dir`` is the stream's durable home (journal + checkpoints);
+        constructing over a directory with committed batches RESUMES
+        from the last committed cursor.  Returns the
+        :class:`~..stream.Stream` handle (poll_once/drain/status/
+        snapshot/close)."""
+        from ..stream import Stream
+        comm = getattr(self.backend, "mesh", None)
+        return Stream(dir, sources, parser=parser, reduce=reduce,
+                      comm=comm, resident=self, **kw)
+
     def open(self, addflag: int = 0):
         """Begin cross-MR adds: my KV accepts kv.add() from other MRs'
         callbacks until close() (reference src/mapreduce.cpp:1648-1664)."""

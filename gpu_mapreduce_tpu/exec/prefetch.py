@@ -20,20 +20,95 @@ recovered fault never reorders the stream — only an EXHAUSTED budget
 surfaces here, as the producer error the consumer re-raises.
 
 Telemetry: one ``exec.prefetch`` span per stream (emitted from the
-producer thread: items, busy seconds) and a cumulative
-:func:`..exec.note_overlap` record driving ``mrtpu_overlap_ratio{path}``.
+producer thread: items, busy seconds), a cumulative
+:func:`..exec.note_overlap` record driving ``mrtpu_overlap_ratio{path}``,
+and two direct metrics the stream plane attributes lag with
+(doc/streaming.md#lag-attribution): ``mrtpu_prefetch_depth{path}``
+(look-ahead actually banked — producer ahead of consumer) and
+``mrtpu_prefetch_wait_seconds_total{path}`` (consumer blocked on the
+producer — ingest-bound time).
+
+Tail/follow mode: :func:`tail_chunks` reads whatever an append-only
+file grew past an offset cursor — newline-aligned so a torn mid-line
+append is never split across micro-batches — and returns the advanced
+cursor with the chunk.  The stream/ tailers poll it; exactly-once
+comes from committing the returned cursor atomically with the batch
+that consumed it (stream/engine.py).
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
-from typing import Iterable, Iterator, Optional
+from typing import Iterable, Iterator, List, Optional, Tuple
 
 _END = "end"
 _ITEM = "item"
 _ERR = "err"
+
+
+def _prefetch_metrics(path: str):
+    """(depth_gauge_setter, wait_counter_adder) for one stream label —
+    resolved once per prefetch stream, no-ops when the registry is
+    unavailable."""
+    try:
+        from ..obs.metrics import get_registry
+        reg = get_registry()
+        depth = reg.gauge(
+            "mrtpu_prefetch_depth",
+            "items the prefetch producer holds ahead of the consumer",
+            ("path",))
+        wait = reg.counter(
+            "mrtpu_prefetch_wait_seconds_total",
+            "seconds the consumer spent blocked on the prefetch "
+            "producer (ingest-bound time)", ("path",))
+        return (lambda n: depth.set(n, path=path),
+                lambda s: wait.inc(s, path=path))
+    except Exception:
+        return (lambda n: None), (lambda s: None)
+
+
+def tail_chunks(path: str, offset: int = 0,
+                max_bytes: Optional[int] = None,
+                final: bool = False) -> Tuple[List[bytes], int]:
+    """One follow-mode poll of an append-only file: the bytes ``path``
+    grew past ``offset``, newline-aligned, as ``(chunks, new_offset)``.
+
+    Only whole lines are consumed — a producer caught mid-``write()``
+    leaves a torn tail that stays pending until its newline lands, so
+    a record never splits across two micro-batches.  ``final=True``
+    (stream close/drain) consumes the unterminated tail too.
+    ``max_bytes`` bounds one poll (backpressure: the rest stays
+    pending for the next cut).  A file shorter than ``offset``
+    (truncated — NOT append-only) raises ``OSError`` so the caller can
+    surface a real error instead of silently re-reading."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return [], offset               # not born yet: nothing pending
+    if size < offset:
+        raise OSError(f"{path!r} shrank below cursor {offset} "
+                      f"(size {size}): tailed sources must be "
+                      f"append-only")
+    if size == offset:
+        return [], offset
+    want = size - offset
+    if max_bytes is not None:
+        want = min(want, max_bytes)
+    with open(path, "rb") as f:
+        f.seek(offset)
+        buf = f.read(want)
+    if not buf:
+        return [], offset
+    cut = len(buf)
+    if not final:
+        nl = buf.rfind(b"\n")
+        if nl < 0:
+            return [], offset           # torn line: wait for its \n
+        cut = nl + 1
+    return [buf[:cut]], offset + cut
 
 
 def prefetch_iter(src: Iterable, depth: Optional[int] = None,
@@ -52,6 +127,7 @@ def prefetch_iter(src: Iterable, depth: Optional[int] = None,
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
     state = {"busy": 0.0, "items": 0, "inflight_max": 0}
+    set_depth, add_wait = _prefetch_metrics(path)
     # trace-context handoff (obs/context.py): the producer thread runs
     # the CONSUMER's request — its exec.prefetch span and any counters
     # the source iterator bumps must charge the submitting request, not
@@ -89,6 +165,7 @@ def prefetch_iter(src: Iterable, depth: Optional[int] = None,
                     state["items"] += 1
                     state["inflight_max"] = max(state["inflight_max"],
                                                 q.qsize() + 1)
+                    set_depth(q.qsize() + 1)
                     _put((_ITEM, item))
                 sp.set(items=state["items"],
                        busy_s=round(state["busy"], 6),
@@ -108,6 +185,7 @@ def prefetch_iter(src: Iterable, depth: Optional[int] = None,
             t0 = time.perf_counter()
             kind, payload = q.get()
             wait += time.perf_counter() - t0
+            set_depth(q.qsize())
             if kind == _END:
                 break
             if kind == _ERR:
@@ -122,6 +200,8 @@ def prefetch_iter(src: Iterable, depth: Optional[int] = None,
         except queue.Empty:
             pass
         t.join(timeout=10.0)
+        set_depth(0)
+        add_wait(wait)
         from . import note_overlap
         note_overlap(path, busy_s=state["busy"], wait_s=wait,
                      items=state["items"])

@@ -281,6 +281,95 @@ class ServeClient:
                 except ValueError:
                     continue    # torn final line on daemon stop
 
+    # -- standing queries (doc/streaming.md) -------------------------------
+    def stream_open(self, sources: Optional[list] = None,
+                    parser: str = "words", reduce: str = "count",
+                    window: int = 0, tenant: Optional[str] = None,
+                    deadline_ms: Optional[int] = None,
+                    batch: Optional[dict] = None) -> dict:
+        """``POST /v1/streams`` — open a standing query.  ``sources``
+        omitted opens a FEED stream (push bytes via
+        :meth:`stream_feed`); otherwise the daemon tails the given
+        files/directories.  Returns ``{"id", "state", ...}``."""
+        body: dict = {"parser": parser, "reduce": reduce}
+        if sources is not None:
+            body["sources"] = list(sources)
+        if window:
+            body["window"] = int(window)
+        if tenant is not None:
+            body["tenant"] = tenant
+        if deadline_ms is not None:
+            body["deadline_ms"] = int(deadline_ms)
+        if batch:
+            body["batch"] = dict(batch)
+        return self._req("POST", "/v1/streams", body)
+
+    def stream_feed(self, stid: str, data: bytes) -> dict:
+        """``POST /v1/streams/<id>/feed`` — append raw bytes to a feed
+        stream (newline-terminated records; a torn tail line waits for
+        its newline)."""
+        if isinstance(data, str):
+            data = data.encode()
+        req = urllib.request.Request(
+            self.base + f"/v1/streams/{stid}/feed", data=data,
+            method="POST", headers={**self._headers(),
+                                    "Content-Type":
+                                        "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            raw = e.read().decode(errors="replace")
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                body = {"error": raw}
+            ra = e.headers.get("Retry-After")
+            raise ServeError(e.code, body,
+                             int(ra) if ra and ra.isdigit() else None) \
+                from None
+
+    def streams(self) -> list:
+        return self._req("GET", "/v1/streams")["streams"]
+
+    def stream_status(self, stid: str) -> dict:
+        return self._req("GET", f"/v1/streams/{stid}")
+
+    def stream_close(self, stid: str, drain: bool = True) -> dict:
+        """``POST /v1/streams/<id>/close`` — final-drain (unless
+        ``drain=False``) and retire the query; returns the terminal
+        summary."""
+        return self._req("POST", f"/v1/streams/{stid}/close",
+                         {"drain": bool(drain)})
+
+    def stream_events(self, stid: str, timeout: Optional[float] = None):
+        """Generator over ``GET /v1/streams/<id>/events``: one dict
+        per streamed JSON line (status, per-batch commits, ticks)
+        until a terminal status — same chunked contract as
+        :meth:`events`."""
+        req = urllib.request.Request(
+            self.base + f"/v1/streams/{stid}/events",
+            headers=self._headers())
+        try:
+            r = urllib.request.urlopen(
+                req, timeout=timeout if timeout is not None else 60.0)
+        except urllib.error.HTTPError as e:
+            raw = e.read().decode(errors="replace")
+            try:
+                body = json.loads(raw)
+            except ValueError:
+                body = {"error": raw}
+            raise ServeError(e.code, body) from None
+        with r:
+            for line in r:
+                line = line.decode(errors="replace").strip()
+                if not line:
+                    continue
+                try:
+                    yield json.loads(line)
+                except ValueError:
+                    continue    # torn final line on daemon stop
+
     def slo(self) -> dict:
         return self._req("GET", "/v1/slo")
 

@@ -236,6 +236,12 @@ class Server:
         self._watch: Dict[str, List] = {}
         self._trace_sids: Dict[str, str] = {}
         self._watch_lock = threading.Lock()
+        # standing queries (PR 20): POST /v1/streams open micro-batch
+        # streams that outlive any one request (serve/streams.py +
+        # stream/engine.py); journaled like submits, recovered like
+        # sessions, adopted on fleet takeover
+        from .streams import StreamManager
+        self.streams = StreamManager(self)
 
     # -- paths -------------------------------------------------------------
     def session_dir(self, sid: str) -> str:
@@ -402,12 +408,19 @@ class Server:
         claim_recs: List[tuple] = []    # (idx, fleet_claimed record)
         cas_intents: List[list] = []    # interrupted CAS chunk sweeps
         memo_intents: List[list] = []   # interrupted memo-entry sweeps
+        stream_opens: List[dict] = []   # standing queries (streams.py)
+        stream_closes: set = set()
         for i, r in enumerate(recs):
             if r.get("kind") == "serve_submit":
                 submits.append({**r, "_idx": i})
                 # mrlint: disable=lock-unguarded-mutation — _recover
                 # runs inside start(), before the worker pool spawns
                 self._seq = max(self._seq, int(r.get("seq", 0)))
+            elif r.get("kind") == "stream_open":
+                stream_opens.append({**r, "_idx": i})
+                self.streams.note_seq(r)
+            elif r.get("kind") == "stream_close":
+                stream_closes.add(r.get("stid", ""))
             elif r.get("kind") == "serve_done":
                 done[r.get("sid", "")] = r.get("status", DONE)
             elif r.get("kind") == "serve_cancel":
@@ -441,6 +454,8 @@ class Server:
             # leave everything before the last claim to its claimant
             submits = [r for r in submits
                        if r["_idx"] > claim_recs[-1][0]]
+            stream_opens = [r for r in stream_opens
+                            if r["_idx"] > claim_recs[-1][0]]
         elif claim_recs:
             # a peer claimed this journal (we died, it took over).
             # Every submit before a COMPLETED claim belongs to that
@@ -454,6 +469,8 @@ class Server:
                             if r.get("gen", -1) in done_gens),
                            default=-1)
             submits = [r for r in submits if r["_idx"] > boundary]
+            stream_opens = [r for r in stream_opens
+                            if r["_idx"] > boundary]
             cur = self._fleet.current_claim(self.rid)
             if cur is not None and not cur[1].get("done"):
                 # an UNFINISHED claim: those sessions are in takeover
@@ -469,6 +486,8 @@ class Server:
                 if reclaim is None:
                     last = max(i for i, r in claim_recs)
                     submits = [r for r in submits if r["_idx"] > last]
+                    stream_opens = [r for r in stream_opens
+                                    if r["_idx"] > last]
                 else:
                     # ours again — already durably journaled HERE,
                     # which is exactly what claim_done certifies
@@ -538,6 +557,12 @@ class Server:
                 self._order.append(sid)
             with self._watch_lock:
                 self._trace_sids[sess.trace_id] = sid
+        # standing queries without a stream_close re-open here: each
+        # engine resumes from ITS journal's last committed cursors, so
+        # a kill -9 mid-batch restarts at exactly-once state
+        self.streams.recover(
+            [r for r in stream_opens
+             if r.get("stid", "") not in stream_closes])
 
     # -- fleet: heartbeat, failover, fencing -------------------------------
     def _fleet_loop(self) -> None:
@@ -639,9 +664,13 @@ class Server:
                 pstate = please.get("state_dir") or os.path.join(
                     self.fleet_dir, "replicas", prev)
                 try:
+                    prs = read_journal(pstate)
                     owned_elsewhere.update(
-                        pr.get("sid", "") for pr in read_journal(pstate)
+                        pr.get("sid", "") for pr in prs
                         if pr.get("kind") == "serve_submit")
+                    owned_elsewhere.update(
+                        pr.get("stid", "") for pr in prs
+                        if pr.get("kind") == "stream_open")
                 except MRError:
                     pass
             # the fence record, BEFORE any replay
@@ -656,11 +685,17 @@ class Server:
             gcd: set = set()
             cancels: Dict[str, str] = {}
             submits: List[dict] = []
+            stream_opens: List[dict] = []
+            stream_closes: set = set()
             boundary = -1
             for i, r in enumerate(recs):
                 kind = r.get("kind")
                 if kind == "serve_submit":
                     submits.append({**r, "_idx": i})
+                elif kind == "stream_open":
+                    stream_opens.append({**r, "_idx": i})
+                elif kind == "stream_close":
+                    stream_closes.add(r.get("stid", ""))
                 elif kind == "serve_done":
                     done[r.get("sid", "")] = r.get("status", DONE)
                 elif kind == "serve_cancel":
@@ -744,8 +779,20 @@ class Server:
                     with self._watch_lock:
                         self._trace_sids[sess.trace_id] = sid
                 n += 1
+            # the dead replica's OPEN streams move here too: copy each
+            # durable stream directory, re-journal stream_open under
+            # OUR journal, resume from its last committed cursor
+            nst = 0
+            for r in stream_opens:
+                stid = r.get("stid", "")
+                if not stid or stid in stream_closes \
+                        or stid in owned_elsewhere \
+                        or r["_idx"] <= boundary:
+                    continue
+                if self.streams.adopt(r, dead_state, dead_rid):
+                    nst += 1
             self._fleet.claim_done(dead_rid, claim["gen"])
-            sp.set(sessions=n)
+            sp.set(sessions=n, streams=nst)
         fleet_mod.note_failover(time.monotonic() - t0)
 
     def drain(self) -> None:
@@ -758,6 +805,13 @@ class Server:
         self.drain()
         self.queue.close()
         self._stopped.set()
+        # open streams SUSPEND (runners stop, engine journals close, no
+        # stream_close record): they are durable state the next start —
+        # or a fleet survivor — resumes from the last committed cursor
+        try:
+            self.streams.suspend_all()
+        except Exception:
+            pass
         for t in self._workers:
             t.join(timeout=timeout)
         self._workers = []
@@ -1603,6 +1657,7 @@ class Server:
                 "fleet": fleet,
                 "sessions": {"active": active, "by_state": states,
                              "total": len(self._order)},
+                "streams": self.streams.snapshot(),
                 "tenants": self.budgets.snapshot(),
                 "ratelimit": self.ratelimit.snapshot(),
                 "gc": {"ttl_s": self.ttl_s, "swept": self.gc_count},
@@ -1737,6 +1792,8 @@ class Server:
                     "application/json", None
             return 200, self._events_stream(rest[1]), \
                 "application/x-ndjson", None
+        if rest and rest[0] == "streams":
+            return self._handle_streams(method, rest[1:], body, ident)
         if method == "GET" and rest == ["slo"]:
             # burn rates cover EVERY tenant — operator surface, like
             # /v1/stats below (a tenant token must not read its
@@ -1776,6 +1833,69 @@ class Server:
             threading.Thread(target=self._deferred_shutdown,
                              daemon=True).start()
             return 200, {"shutting_down": True}, "application/json", None
+        return 404, {"error": "not found"}, "application/json", None
+
+    def _handle_streams(self, method: str, rest: List[str],
+                        body: bytes, ident: Optional[str]) -> tuple:
+        """``/v1/streams`` routing (serve/streams.py): open / list /
+        status / feed / events / close.  Tenant scoping mirrors jobs:
+        a foreign stream id answers 404, never 403 (no existence
+        oracle over sequential ids)."""
+        import json
+        if method == "POST" and not rest:
+            try:
+                obj = json.loads(body.decode() or "{}")
+                if not isinstance(obj, dict):
+                    raise ValueError("body must be a JSON object")
+            except (ValueError, UnicodeDecodeError) as e:
+                return 400, {"error": f"bad JSON body: {e}"}, \
+                    "application/json", None
+            if ident is not None and ident != "*" \
+                    and not obj.get("tenant"):
+                obj["tenant"] = ident
+            denied = self._authz(
+                ident, tenant=str(obj.get("tenant") or "default"))
+            if denied:
+                return denied
+            code, out, extra = self.streams.open(obj)
+            return code, out, "application/json", extra
+        if method == "GET" and not rest:
+            out = self.streams.list()
+            if ident is not None and ident != "*":
+                out = [s for s in out if s.get("tenant") == ident]
+            return 200, {"streams": out}, "application/json", None
+        if not rest:
+            return 404, {"error": "not found"}, "application/json", None
+        stid = rest[0]
+        ss = self.streams.get(stid)
+        if ss is None:
+            return 404, {"error": f"no stream {stid!r}"}, \
+                "application/json", None
+        denied = self._authz(ident, tenant=ss.tenant)
+        if denied:
+            if denied[0] == 403:
+                return 404, {"error": f"no stream {stid!r}"}, \
+                    "application/json", None
+            return denied
+        if method == "GET" and len(rest) == 1:
+            return 200, ss.summary(), "application/json", None
+        if method == "GET" and rest[1:] == ["events"]:
+            return 200, self.streams.events_stream(stid), \
+                "application/x-ndjson", None
+        if method == "POST" and rest[1:] == ["feed"]:
+            code, out = self.streams.feed(stid, body)
+            return code, out, "application/json", None
+        if (method == "DELETE" and len(rest) == 1) or \
+                (method == "POST" and rest[1:] == ["close"]):
+            drain = True
+            if method == "POST" and body:
+                try:
+                    drain = bool(json.loads(body.decode() or "{}")
+                                 .get("drain", True))
+                except (ValueError, UnicodeDecodeError):
+                    pass
+            code, out = self.streams.close(stid, drain=drain)
+            return code, out, "application/json", None
         return 404, {"error": "not found"}, "application/json", None
 
     def _deferred_shutdown(self) -> None:

@@ -44,9 +44,12 @@ from ..utils.env import env_flag
 
 MEMO_SCHEMA = 1
 
-# script features that break the exactness contract (module docstring)
+# script features that break the exactness contract (module docstring).
+# ``stream`` is here because a standing query's answer is a moving
+# target over growing inputs — never a pure function of the submission
+# (doc/streaming.md#memoization)
 _NONDET_SET = ("timer", "verbosity")
-_SIDE_EFFECT_CMDS = ("save", "load")
+_SIDE_EFFECT_CMDS = ("save", "load", "stream")
 
 _LOCK = threading.Lock()
 _COUNTS = {"hits": 0, "misses": 0, "stores": 0, "corrupt": 0}
@@ -111,6 +114,54 @@ def input_manifest(payload: str) -> Optional[List[Tuple[str, int, str]]]:
             except OSError:
                 return None
     return sorted((p, s, d) for p, (s, d) in files.items())
+
+
+def stat_manifest(payload: str) -> List[Tuple[str, int, float]]:
+    """(abspath, size, mtime) per existing input file — the CHEAP
+    staleness probe stored alongside the result.  Unlike
+    :func:`input_manifest` (which feeds the key and pays a crc per
+    file), this one only stats: it exists so :func:`lookup` can detect
+    a file that GREW between key computation and the hit being served
+    (append-only inputs under a standing query do exactly that) and
+    fall through to recompute instead of serving a stale record."""
+    files = {}
+    for raw in payload.split():
+        tok = raw.strip("\"'").rstrip(",;")
+        if not tok or tok.startswith("-"):
+            continue
+        if any(c in tok for c in "*?["):
+            matches = sorted(glob.glob(tok))
+        elif os.path.exists(tok):
+            matches = [tok]
+        else:
+            continue
+        for m in matches:
+            if not os.path.isfile(m):
+                continue
+            try:
+                st = os.stat(m)
+                files[os.path.abspath(m)] = (st.st_size, st.st_mtime)
+            except OSError:
+                continue
+    return sorted((p, s, t) for p, (s, t) in files.items())
+
+
+def manifest_stale(manifest) -> bool:
+    """True when any recorded input changed shape since the record was
+    stored — grew, shrank, vanished, or was rewritten in place (mtime
+    moved)."""
+    for ent in manifest or ():
+        try:
+            path, size, mtime = ent[0], int(ent[1]), float(ent[2])
+        except (TypeError, ValueError, IndexError):
+            return True
+        try:
+            st = os.stat(path)
+        except OSError:
+            return True
+        if st.st_size != size or st.st_mtime != mtime:
+            return True
+    return False
 
 
 def memo_key(payload: str) -> Optional[str]:
@@ -180,14 +231,25 @@ def lookup(key: str) -> Optional[dict]:
         except OSError:
             pass
         return None
+    # staleness re-stat (size+mtime) BEFORE serving the hit: an input
+    # that grew since the record was stored (append-only files under a
+    # standing query do) must recompute, not serve the old answer.  Not
+    # corruption — the entry stays for the key that still matches it
+    if manifest_stale(rec.get("manifest")):
+        _note("misses")
+        return None
     _note("hits")
     return result
 
 
-def store(key: str, result: dict, writer: str = "") -> bool:
+def store(key: str, result: dict, writer: str = "",
+          payload: Optional[str] = None) -> bool:
     """Persist one DONE result under its key (atomic + stamped).  The
     record keeps the full result — output, files (inline text included)
-    and mrs — because a hit must reproduce all of them byte-for-byte."""
+    and mrs — because a hit must reproduce all of them byte-for-byte.
+    ``payload`` (the script text) adds the stat manifest
+    (:func:`stat_manifest`) that :func:`lookup` re-checks before
+    serving: a grown input reads as a miss."""
     from ..utils.integrity import digest_bytes
     path = _memo_path(key)
     if path is None or result.get("status") != "done":
@@ -195,6 +257,7 @@ def store(key: str, result: dict, writer: str = "") -> bool:
     body = json.dumps(result, sort_keys=True).encode()
     rec = {"c": digest_bytes(body), "schema": MEMO_SCHEMA, "key": key,
            "writer": writer,
+           "manifest": stat_manifest(payload) if payload else [],
            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            "result": result}
     try:

@@ -472,7 +472,9 @@ def run_session(server, sess: Session) -> dict:
     # boundary, and the contract is "what a fresh run produces".
     if mkey is not None and status == DONE and not sess.resumed:
         try:
-            memo_mod.store(mkey, result, writer=getattr(server, "rid", ""))
+            memo_mod.store(mkey, result,
+                           writer=getattr(server, "rid", ""),
+                           payload=sess.payload)
         except Exception:
             pass
     # the durable result lands BEFORE the state flips: a client polling

@@ -72,6 +72,14 @@ ADVISORY_METRICS = (
     # — advisory: a micro-cycle's wall is noisy at this scale; the
     # hard invariants live in tests/test_context.py
     ("profile_overhead_pct", -1),
+    # standing-query rows (bench.py --stream, detail.stream_ab):
+    # steady-state micro-batch wall (journal fsync + checkpoint per
+    # commit included) and sustained commit rate — advisory because
+    # tiny CPU batch walls are noisy; the hard invariants
+    # (byte-identical incremental vs one-shot, exactly-once) live in
+    # tests/test_stream.py
+    ("stream_batch_p50_ms", -1),
+    ("stream_batches_per_sec", +1),
     # wire-codec rows (bench.py --wire, detail.wire_ab): exchanged-byte
     # reduction + compression ratio on the skewed shuffle-bound
     # intcount, and the codec's wall cost — advisory because the CPU
@@ -180,6 +188,14 @@ def record_metrics(rec: dict) -> Optional[dict]:
     pab = det.get("profile_ab") or {}
     if not pab.get("error") and pab.get("overhead_pct") is not None:
         m["profile_overhead_pct"] = pab["overhead_pct"]
+    stab = det.get("stream_ab") or {}
+    if not stab.get("error") and stab.get("identical"):
+        # only rounds whose incremental/one-shot snapshots agreed get a
+        # row — a broken golden must not feed the trend
+        if stab.get("batch_p50_ms") is not None:
+            m["stream_batch_p50_ms"] = stab["batch_p50_ms"]
+        if stab.get("batches_per_sec") is not None:
+            m["stream_batches_per_sec"] = stab["batches_per_sec"]
     wab = det.get("wire_ab") or {}
     wic = wab.get("intcount") or {}
     if not wab.get("error") and wic:

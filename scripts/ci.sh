@@ -20,6 +20,8 @@
 #   scripts/ci.sh obsdist  # fleet observability subset (sync observer/
 #                          # federation units + stitched-trace golden,
 #                          # straggler attribution, federation chaos)
+#   scripts/ci.sh stream   # standing-query subset (tailer/cutter units,
+#                          # incremental + kill-9 goldens, stream takeover)
 #   scripts/ci.sh cache    # caching-tier subset (CAS/memo units +
 #                          # warm-restart/fleet hits, corruption
 #                          # fallback, GC intent replay)
@@ -162,6 +164,19 @@ run_obsdist_subset_full() {
       -p no:cacheprovider -p no:xdist -p no:randomly
 }
 
+run_stream_subset_quick() {
+  echo "== stream subset (fast): tailer/cutter units + incremental goldens + watermark/lag =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_stream.py -q \
+      -m 'not slow' -k 'not kill9 and not fleet and not serve' \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
+run_stream_subset_full() {
+  echo "== stream subset (full): kill -9 exactly-once, serve surface, fleet stream takeover =="
+  env JAX_PLATFORMS=cpu python -m pytest tests/test_stream.py -q \
+      -p no:cacheprovider -p no:xdist -p no:randomly
+}
+
 run_cache_subset_quick() {
   echo "== caching-tier subset (fast): CAS store units + memo key/verify =="
   env JAX_PLATFORMS=cpu python -m pytest tests/test_cas.py tests/test_memo.py -q \
@@ -232,6 +247,12 @@ if [ "${1:-}" = "obsdist" ]; then
   exit 0
 fi
 
+if [ "${1:-}" = "stream" ]; then
+  run_stream_subset_quick
+  run_stream_subset_full
+  exit 0
+fi
+
 if [ "${1:-}" = "cache" ]; then
   run_cache_subset_quick
   run_cache_subset_full
@@ -256,6 +277,7 @@ if [ "${1:-}" = "quick" ]; then
   run_dist_subset_quick
   run_obsdist_subset_quick
   run_cache_subset_quick
+  run_stream_subset_quick
   run_context_subset
   run_elastic_subset_quick
   run_wire_subset_quick
@@ -285,6 +307,7 @@ run_fleet_subset_full
 run_dist_subset_full
 run_obsdist_subset_full
 run_cache_subset_full
+run_stream_subset_full
 run_context_subset
 run_elastic_subset_full
 run_wire_subset_full

@@ -10,6 +10,12 @@
     mrctl.py [...] cancel SID                   # DELETE /v1/jobs/<sid>
     mrctl.py [...] profile SID                  # per-request cost profile
     mrctl.py [...] watch SID [--timeout SECS]   # stream /events (no poll)
+    mrctl.py [...] stream open [--source PATH ...] [--parser P]
+             [--reduce R] [--window N] [--tenant T]   # standing query
+    mrctl.py [...] stream status [STID]
+    mrctl.py [...] stream feed STID FILE|-      # append bytes (feed mode)
+    mrctl.py [...] stream close STID [--no-drain]
+    mrctl.py [...] stream watch STID [--timeout SECS]  # /events client
     mrctl.py [...] slo
     mrctl.py [...] stats
     mrctl.py [...] cache [--json]               # caching-tier view
@@ -198,6 +204,48 @@ def main(argv=None) -> int:
                     metavar="SECS",
                     help="give up (exit 6) if the session has not "
                          "reached a terminal state by then")
+    sm = sub.add_parser("stream", help="standing-query micro-batch "
+                                       "streams (doc/streaming.md)")
+    ssub = sm.add_subparsers(dest="streamcmd", required=True)
+    so = ssub.add_parser("open")
+    so.add_argument("--source", action="append", default=None,
+                    metavar="PATH",
+                    help="file/directory the daemon tails (repeatable); "
+                         "omitted = feed mode, push bytes with "
+                         "'stream feed'")
+    so.add_argument("--parser", default="words",
+                    help="record parser: words, lines, kv")
+    so.add_argument("--reduce", default="count",
+                    help="reduce kernel: count, sum, min, max")
+    so.add_argument("--window", type=int, default=0,
+                    help="keep only the last N micro-batches resident "
+                         "(0 = accumulate forever)")
+    so.add_argument("--tenant", default=None)
+    so.add_argument("--deadline-ms", type=int, default=None,
+                    help="total execution budget across the stream's "
+                         "life")
+    so.add_argument("--rows", type=int, default=None,
+                    help="micro-batch row trigger")
+    so.add_argument("--bytes", type=int, default=None,
+                    help="micro-batch byte trigger")
+    so.add_argument("--wait-ms", type=int, default=None,
+                    help="latency floor: cut any pending data older "
+                         "than this")
+    ss = ssub.add_parser("status")
+    ss.add_argument("stid", nargs="?")
+    sf = ssub.add_parser("feed")
+    sf.add_argument("stid")
+    sf.add_argument("file", help="bytes to append, or - for stdin")
+    sc = ssub.add_parser("close")
+    sc.add_argument("stid")
+    sc.add_argument("--no-drain", action="store_true",
+                    help="retire without processing pending data")
+    sw = ssub.add_parser("watch")
+    sw.add_argument("stid")
+    sw.add_argument("--timeout", type=float, default=3600.0,
+                    metavar="SECS",
+                    help="give up (exit 6) if the stream has not "
+                         "reached a terminal state by then")
     sub.add_parser("slo")
     sub.add_parser("stats")
     cc = sub.add_parser("cache", help="caching-tier hit ratios, store "
@@ -285,6 +333,65 @@ def main(argv=None) -> int:
             print(f"session {args.sid} not finished by the --timeout "
                   f"deadline", file=sys.stderr)
             return 6
+        elif args.cmd == "stream":
+            if args.streamcmd == "open":
+                batch = {k: v for k, v in
+                         (("rows", args.rows), ("bytes", args.bytes),
+                          ("wait_ms", args.wait_ms)) if v is not None}
+                r = c.stream_open(sources=args.source,
+                                  parser=args.parser,
+                                  reduce=args.reduce,
+                                  window=args.window,
+                                  tenant=args.tenant,
+                                  deadline_ms=args.deadline_ms,
+                                  batch=batch or None)
+                print(json.dumps(r))
+            elif args.streamcmd == "status":
+                out = c.stream_status(args.stid) if args.stid \
+                    else c.streams()
+                print(json.dumps(out, indent=2))
+            elif args.streamcmd == "feed":
+                data = sys.stdin.buffer.read() if args.file == "-" \
+                    else open(args.file, "rb").read()
+                print(json.dumps(c.stream_feed(args.stid, data)))
+            elif args.streamcmd == "close":
+                r = c.stream_close(args.stid,
+                                   drain=not args.no_drain)
+                print(json.dumps(r, indent=2))
+                return 5 if r.get("state") == "failed" else 0
+            elif args.streamcmd == "watch":
+                # same contract as `watch`: streamed events, reconnect
+                # across the server-side cap, exit at terminal status
+                # (0 closed / 5 failed) or 6 at the operator deadline
+                import time as _time
+                deadline = _time.monotonic() + args.timeout
+                last_state = None
+                expired = False
+                while not expired:
+                    for ev in c.stream_events(args.stid, timeout=60.0):
+                        kind = ev.get("event")
+                        if kind == "tick":
+                            if _time.monotonic() > deadline:
+                                expired = True
+                                break
+                            continue
+                        if kind == "status" and \
+                                ev.get("state") == last_state:
+                            continue
+                        print(json.dumps(ev))
+                        if kind == "error":
+                            print(ev.get("error"), file=sys.stderr)
+                            return 3
+                        if kind == "status":
+                            last_state = ev.get("state")
+                            if last_state in ("closed", "failed"):
+                                return 5 if last_state == "failed" \
+                                    else 0
+                    else:
+                        expired = _time.monotonic() > deadline
+                print(f"stream {args.stid} not finished by the "
+                      f"--timeout deadline", file=sys.stderr)
+                return 6
         elif args.cmd == "slo":
             print(json.dumps(c.slo(), indent=2))
         elif args.cmd == "stats":

@@ -13,7 +13,10 @@ framework surface:
   the BASELINE.json north-star metric (the reference's pagerank is a
   stub, oink/pagerank.cpp:53-55, so this races no reference number)
 
-Usage:  python soak.py [--metrics-every N] [--chaos SEED] [dist]
+Usage:  python soak.py [--metrics-every N] [--chaos SEED] [dist|stream]
+        (`soak.py stream` runs ONLY the standing-query soak: a
+        feed-mode stream on an in-process daemon, publishing
+        stream_batches_per_sec + stream_lag_p99_ms — doc/streaming.md)
         (`soak.py dist` runs ONLY the multi-process shrink-and-resume
         soak: a 4-process mrlaunch wordfreq with one rank SIGKILLed
         mid-run, asserting byte-identical output vs an uninterrupted
@@ -1003,6 +1006,55 @@ def main():
                             p.kill()
                             p.wait()
 
+    def do_stream():
+        # standing-query soak (stream/ + serve/streams.py,
+        # doc/streaming.md): a feed-mode stream on an in-process daemon
+        # ingests the soak corpus chunk by chunk; published numbers are
+        # sustained committed micro-batches/sec and the p99 of the
+        # event-time lag samples observed while data was pending
+        import tempfile
+
+        from gpu_mapreduce_tpu.serve import Server, ServeClient
+        nchunks = env_knob("SOAK_STREAM_CHUNKS", int, 24)
+        rng5 = np.random.default_rng(29)
+        chunk = (" ".join(
+            f"w{w:04d}" for w in rng5.integers(0, 512, 4000))
+            + "\n").encode()
+        with tempfile.TemporaryDirectory() as tmp:
+            srv = Server(port=0, workers=1,
+                         state_dir=os.path.join(tmp, "state"))
+            port = srv.start()
+            try:
+                c = ServeClient.local(port)
+                stid = c.stream_open(
+                    batch={"rows": 2000, "wait_ms": 50})["id"]
+                lags: list = []
+                batches = 0
+                t0 = time.perf_counter()
+                for _ in range(nchunks):
+                    c.stream_feed(stid, chunk)
+                    # sample lag until this chunk's batch commits —
+                    # the samples ARE the latency evidence
+                    give_up = time.monotonic() + 60
+                    while time.monotonic() < give_up:
+                        st = c.stream_status(stid)["stream"]
+                        lags.append(st["lag_s"] * 1000.0)
+                        if st["batches"] > batches:
+                            batches = st["batches"]
+                            break
+                        time.sleep(0.01)
+                dt = time.perf_counter() - t0
+                out = c.stream_close(stid)
+                assert out["stream"]["rows"] == nchunks
+                published["stream_batches_per_sec"] = round(
+                    out["stream"]["batches"] / dt, 2)
+                lags.sort()
+                published["stream_lag_p99_ms"] = round(
+                    lags[min(len(lags) - 1,
+                             int(len(lags) * 0.99))], 2)
+            finally:
+                srv.shutdown()
+
     def do_dist():
         # multi-process data plane soak (doc/distributed.md): a real
         # 4-process mrlaunch wordfreq with rank 2 SIGKILLed mid-run —
@@ -1072,7 +1124,7 @@ def main():
                  ("pagerank", do_pagerank),
                  ("pagerank_northstar", do_pagerank_northstar),
                  ("serve", do_serve), ("overload", do_overload),
-                 ("fleet", do_fleet)]
+                 ("fleet", do_fleet), ("stream", do_stream)]
     if chaos_seed is not None:
         workloads.append(("chaos", do_chaos))
     serve_only = "serve" in sys.argv[1:]
@@ -1087,6 +1139,11 @@ def main():
         # `soak.py overload`: ONLY the shed-the-greedy-tenant soak
         # (doc/serve.md#slo-burn-shedding)
         workloads = [("overload", do_overload)]
+        serve_only = True       # partial publish: merge, don't erase
+    if "stream" in sys.argv[1:]:
+        # `soak.py stream`: ONLY the standing-query micro-batch soak
+        # (doc/streaming.md)
+        workloads = [("stream", do_stream)]
         serve_only = True       # partial publish: merge, don't erase
     if "dist" in sys.argv[1:]:
         # `soak.py dist`: ONLY the multi-process shrink-and-resume

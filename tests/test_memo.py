@@ -108,6 +108,51 @@ def test_non_memoizable_scripts(tmp_path, cas_env):
     # directory input token: contents unenumerable at key time
     assert memo.memo_key(f"variable files index {tmp_path}\n"
                          f"wordfreq 3 -i v_files\n") is None
+    # standing queries: a moving target, never a pure function of the
+    # submission (doc/streaming.md)
+    assert memo.memo_key("stream open /tmp/st in.txt\n") is None
+    assert memo.memo_key("mr x\nstream poll /tmp/st\n") is None
+
+
+def test_lookup_misses_when_input_grew(tmp_path, cas_env):
+    """PR 20 regression: an input that GREW between store and lookup
+    (append-only files under a standing query do exactly that) must
+    fall through to recompute — the stat manifest stored with the
+    record is re-checked before any hit is served."""
+    corpus = write_corpus(tmp_path / "c.txt", ["a", "b"], 3)
+    payload = wf_script(corpus)
+    key = memo.memo_key(payload)
+    result = {"status": "done", "output": "x\n", "files": {}}
+    assert memo.store(key, result, payload=payload)
+    assert memo.lookup(key) is not None
+    with open(corpus, "a") as f:
+        f.write("more words appended\n")
+    assert memo.lookup(key) is None         # grown input: recompute
+    # staleness is not corruption: the entry survives (its key still
+    # matches the ORIGINAL bytes) and no integrity failure is counted
+    st = memo.memo_stats()
+    assert st["corrupt"] == 0 and st["entries"] == 1
+
+
+def test_session_store_carries_stat_manifest(tmp_path, cas_env):
+    """End-to-end: run_session's store() call passes the payload, so
+    the daemon-written record carries the re-stat manifest."""
+    corpus = write_corpus(tmp_path / "c.txt", ["x", "y", "z"], 4)
+    payload = wf_script(corpus)
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        sid = c.submit(script=payload)["id"]
+        r = c.wait(sid, timeout=60)
+        assert r["status"] == "done"
+        key = memo.memo_key(payload)
+        assert memo.lookup(key) is not None
+        with open(corpus, "a") as f:
+            f.write("grown\n")
+        assert memo.lookup(key) is None
+    finally:
+        srv.shutdown()
 
 
 def test_store_lookup_roundtrip_and_done_only(cas_env):

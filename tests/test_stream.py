@@ -1,0 +1,527 @@
+"""Standing-query micro-batch engine tests (stream/ + the serve and
+OINK surfaces — doc/streaming.md).
+
+The load-bearing goldens: incremental processing is byte-identical to
+one-shot batch over the concatenated input (fuse={0,1}); a kill -9
+mid-batch resumes from the last committed cursor with byte-identical
+recovered state (same process, a fresh process, AND a fleet survivor
+adopting a dead replica's streams); warm same-shaped micro-batches
+recompile nothing (plan-cache steady state)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import Counter
+
+import pytest
+
+from gpu_mapreduce_tpu.core.mapreduce import MapReduce
+from gpu_mapreduce_tpu.core.runtime import MRError
+from gpu_mapreduce_tpu.exec.prefetch import tail_chunks
+from gpu_mapreduce_tpu.oink.command import run_command
+from gpu_mapreduce_tpu.serve import ServeClient, Server
+from gpu_mapreduce_tpu.stream import BatchCutter, Stream, Tailer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def oracle(text: str) -> str:
+    """What one-shot wordfreq over ``text`` prints as the canonical
+    snapshot (sorted ``key count`` lines)."""
+    c = Counter(text.split())
+    return "".join(f"{k} {c[k]}\n" for k in sorted(c))
+
+
+def wait_until(fn, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# units: tailing + cut policy
+# ---------------------------------------------------------------------------
+
+def test_tail_chunks_newline_alignment(tmp_path):
+    p = str(tmp_path / "t.txt")
+    with open(p, "w") as f:
+        f.write("one two\nthree")            # torn trailing line
+    chunks, off = tail_chunks(p, 0)
+    assert b"".join(chunks) == b"one two\n"  # torn tail stays pending
+    assert off == len("one two\n")
+    # the newline arrives: the pending tail is consumed
+    with open(p, "a") as f:
+        f.write(" four\nfive\n")
+    chunks, off2 = tail_chunks(p, off)
+    assert b"".join(chunks) == b"three four\nfive\n"
+    # nothing new: no chunks, cursor stays put
+    chunks, off3 = tail_chunks(p, off2)
+    assert chunks == [] and off3 == off2
+    # final=True consumes an unterminated tail
+    with open(p, "a") as f:
+        f.write("six")
+    chunks, _ = tail_chunks(p, off2, final=True)
+    assert b"".join(chunks) == b"six"
+    # a file that SHRANK is not append-only: loud error, no silent skew
+    with open(p, "w") as f:
+        f.write("tiny")
+    with pytest.raises(OSError):
+        tail_chunks(p, off2)
+
+
+def test_tailer_directory_picks_up_new_files(tmp_path):
+    d = tmp_path / "dir"
+    d.mkdir()
+    (d / "a.txt").write_text("a b\n")
+    t = Tailer([str(d)])
+    chunks, _wm = t.poll()
+    assert b"".join(chunks) == b"a b\n"
+    (d / "b.txt").write_text("c\n")          # born after the tailer
+    chunks, _wm = t.poll()
+    assert b"".join(chunks) == b"c\n"
+    assert t.pending_bytes() == 0
+
+
+def test_batch_cutter_triggers():
+    c = BatchCutter(rows=10, nbytes=100, wait_s=5.0)
+    assert not c.should_cut(0, 0, now=0.0)       # empty never cuts
+    assert not c.should_cut(50, 5, now=0.0)      # under every trigger
+    assert c.should_cut(50, 10, now=0.1)         # rows trigger
+    c.cut_done()
+    assert c.should_cut(100, 1, now=0.2)         # bytes trigger
+    c.cut_done()
+    assert not c.should_cut(1, 1, now=10.0)      # fresh pending
+    assert c.should_cut(1, 1, now=15.0)          # ...aged past wait_s
+
+
+# ---------------------------------------------------------------------------
+# the incremental golden: byte-identical to one-shot, fuse={0,1}
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fuse", [0, 1])
+def test_incremental_wordfreq_golden(tmp_path, fuse):
+    parts = ["apple banana apple\ncherry banana\n",
+             "banana date apple\n",
+             "cherry cherry date elderberry\nfig\n"]
+    src = str(tmp_path / "in.txt")
+    s = Stream(str(tmp_path / "st"), [src],
+               settings={"fuse": fuse})
+    seen = ""
+    for part in parts:                  # grow + drain, one micro-batch
+        with open(src, "a") as f:       # per append round
+            f.write(part)
+        s.drain()
+        seen += part
+        assert s.snapshot() == oracle(seen)     # identical at EVERY step
+    st = s.status()
+    assert st["batches"] == len(parts)
+    assert st["rows"] == sum(p.count("\n") for p in parts)
+    assert st["bytes"] == len(seen.encode())
+    s.close()
+    # one-shot over the concatenated input agrees byte-for-byte
+    one = Stream(str(tmp_path / "one"), [src], settings={"fuse": fuse})
+    one.drain(final=True)
+    assert one.snapshot() == oracle(seen)
+    one.close()
+
+
+def test_kv_parser_sum_reduce(tmp_path):
+    src = tmp_path / "kv.txt"
+    src.write_text("a 3\nb 2\na 5\n")
+    s = Stream(str(tmp_path / "st"), [str(src)], parser="kv",
+               reduce="sum")
+    s.drain()
+    assert s.snapshot() == "a 8\nb 2\n"
+    src.write_text("a 3\nb 2\na 5\nb 10\n")      # append more
+    s.drain()
+    assert s.snapshot() == "a 8\nb 12\n"
+    s.close()
+
+
+def test_window_retire_and_merge(tmp_path):
+    src = str(tmp_path / "in.txt")
+    s = Stream(str(tmp_path / "st"), [src], window=2)
+    batches = ["a a b\n", "b c\n", "c d d\n"]
+    for part in batches:
+        with open(src, "a") as f:
+            f.write(part)
+        s.drain()
+    # only the LAST TWO batches are resident: batch 1 retired
+    assert s.snapshot() == oracle(batches[1] + batches[2])
+    assert s.status()["buckets"] == 2
+    s.close()
+
+
+def test_mr_stream_external_resident(tmp_path):
+    src = tmp_path / "in.txt"
+    src.write_text("x y x\n")
+    mr = MapReduce()
+    s = mr.stream([str(src)], dir=str(tmp_path / "st"))
+    s.drain()
+    assert s.snapshot() == "x 2\ny 1\n"
+    # merges landed in the CALLER's dataset, via public API only
+    got = {}
+    mr2 = mr.copy()
+    mr2.gather(1)
+    mr2.sort_keys(1)
+    mr2.scan_kv(lambda k, v, p: got.__setitem__(bytes(k), int(v)))
+    assert got == {b"x": 2, b"y": 1}
+    s.close()
+
+
+def test_bad_parser_and_reduce_raise(tmp_path):
+    with pytest.raises(MRError):
+        Stream(str(tmp_path / "a"), [], parser="nope")
+    with pytest.raises(MRError):
+        Stream(str(tmp_path / "b"), [], reduce="cull")
+
+
+# ---------------------------------------------------------------------------
+# watermarks + lag attribution
+# ---------------------------------------------------------------------------
+
+def test_watermark_and_lag_accounting(tmp_path):
+    src = str(tmp_path / "in.txt")
+    with open(src, "w") as f:
+        f.write("a b\n")
+    old = time.time() - 50.0
+    os.utime(src, (old, old))
+    s = Stream(str(tmp_path / "st"), [src])
+    s.drain()
+    st = s.status()
+    assert abs(st["watermark"] - old) < 2.0      # newest COMMITTED mtime
+    assert st["lag_s"] == 0.0                    # caught up: no lag
+    # new pending data: lag = now - watermark (the uncommitted tail is
+    # at least that much newer than what the resident state reflects)
+    with open(src, "a") as f:
+        f.write("c d\n")
+    st = s.status()
+    assert st["pending_bytes"] == 4
+    assert st["lag_s"] >= 45.0
+    # ingest attribution rides the prefetch metrics satellite
+    s.drain()
+    st = s.status()
+    assert st["lag_s"] == 0.0
+    assert st["ingest"]["prefetch_wait_s"] >= 0.0
+    assert "prefetch_depth" in st["ingest"]
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once: suspend/resume, kill -9, fleet takeover
+# ---------------------------------------------------------------------------
+
+def test_suspend_resume_roundtrip(tmp_path):
+    src = str(tmp_path / "in.txt")
+    with open(src, "w") as f:
+        f.write("a b a\n")
+    s = Stream(str(tmp_path / "st"), [src])
+    s.drain()
+    s.suspend()                  # no stream_close record: query stays
+    assert s.poll_once(force=True) == 0          # detached handle
+    with open(src, "a") as f:
+        f.write("b c\n")
+    s2 = Stream(str(tmp_path / "st"), [src])
+    assert s2.seq == 1 and s2.status()["resumed"]
+    s2.drain()
+    assert s2.snapshot() == oracle("a b a\nb c\n")
+    s2.close()
+
+
+_KILL_CHILD = r"""
+import os, signal, sys
+sys.path.insert(0, {repo!r})
+from gpu_mapreduce_tpu.stream import Stream
+sdir, src, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+s = Stream(sdir, [src])
+assert s.poll_once(force=True) > 0      # batch 1 commits durably
+with open(src, "a") as f:
+    f.write("banana elderberry banana\nfig\n")
+orig = s._journal.append
+def boom(rec):
+    if mode == "before":                # die BEFORE the commit record
+        os.kill(os.getpid(), signal.SIGKILL)
+    orig(rec)                           # ...or AFTER it is durable
+    os.kill(os.getpid(), signal.SIGKILL)
+s._journal.append = boom
+s.poll_once(force=True)                 # batch 2: dies mid-commit
+raise SystemExit("unreachable: SIGKILL must have fired")
+"""
+
+
+@pytest.mark.parametrize("mode", ["before", "after"])
+def test_kill9_exactly_once_resume(tmp_path, mode):
+    """kill -9 mid-batch, then resume in a FRESH process state: the
+    recovered snapshot is byte-identical to an uninterrupted run —
+    a batch that died before its commit record replays in full, one
+    that died after never reapplies (doc/streaming.md#exactly-once)."""
+    src = str(tmp_path / "in.txt")
+    part1 = "apple banana apple\ncherry\n"
+    part2 = "banana elderberry banana\nfig\n"   # the child appends this
+    with open(src, "w") as f:
+        f.write(part1)
+    sdir = str(tmp_path / "st")
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(_KILL_CHILD.format(repo=REPO))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run([sys.executable, child, sdir, src, mode],
+                       capture_output=True, text=True, env=env,
+                       timeout=240)
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+    s = Stream(sdir, [src])
+    assert s.status()["resumed"]
+    assert s.seq == (1 if mode == "before" else 2)
+    s.drain(final=True)
+    assert s.snapshot() == oracle(part1 + part2)
+    assert s.status()["rows"] == 4               # never double-counted
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# plan-cache steady state: warm micro-batches recompile nothing
+# ---------------------------------------------------------------------------
+
+def test_warm_stream_reuses_cached_plan(tmp_path):
+    from gpu_mapreduce_tpu.plan.cache import cache_stats
+    src = str(tmp_path / "in.txt")
+    s = Stream(str(tmp_path / "st"), [src], settings={"fuse": 1})
+    batch = "alpha beta gamma alpha\ndelta beta\n"
+
+    def feed_one():
+        with open(src, "a") as f:
+            f.write(batch)                  # identical shape each time
+        s.drain()
+
+    feed_one()                              # warm-up: compiles land here
+    feed_one()
+    warm = cache_stats()["plan"]["misses"]
+    for _ in range(3):
+        feed_one()
+    assert cache_stats()["plan"]["misses"] == warm, \
+        "steady-state micro-batches must not recompile"
+    assert s.snapshot() == oracle(batch * 5)
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# the serve surface: /v1/streams
+# ---------------------------------------------------------------------------
+
+def test_serve_stream_http_roundtrip(tmp_path):
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        r = c.stream_open(tenant="acme")            # feed mode
+        stid = r["id"]
+        assert r["state"] == "open" and r["feed"]
+        c.stream_feed(stid, b"apple banana apple\ncherry\n")
+        wait_until(lambda: c.stream_status(stid)["stream"]["batches"]
+                   >= 1, msg="first micro-batch")
+        st = c.stream_status(stid)
+        assert st["tenant"] == "acme"
+        assert st["stream"]["rows"] == 2
+        assert st["stream"]["watermark"] > 0         # fed by commit
+        assert st["stream"]["lag_s"] >= 0.0
+        assert "prefetch_depth" in st["stream"]["ingest"]
+        assert "prefetch_wait_s" in st["stream"]["ingest"]
+        assert len(c.streams()) == 1
+        assert srv.stats()["streams"]["open"] == 1
+        # feeding tail-mode arguments to a CLOSED stream is a 409
+        out = c.stream_close(stid)
+        assert out["state"] == "closed"
+        assert out["stream"]["rows"] == 2
+        from gpu_mapreduce_tpu.serve.client import ServeError
+        with pytest.raises(ServeError) as ei:
+            c.stream_feed(stid, b"late\n")
+        assert ei.value.code == 409
+    finally:
+        srv.shutdown()
+
+
+def test_serve_stream_events_and_watch_contract(tmp_path):
+    import threading
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        stid = c.stream_open()["id"]
+        got = []
+
+        def watch():
+            for ev in c.stream_events(stid, timeout=30.0):
+                got.append(ev)
+                if ev.get("event") == "status" and \
+                        ev.get("state") in ("closed", "failed"):
+                    return
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        time.sleep(0.3)                 # subscription attaches first
+        c.stream_feed(stid, b"x y x\n")
+        wait_until(lambda: any(e.get("event") == "batch" for e in got),
+                   msg="batch event on the stream")
+        c.stream_close(stid)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        kinds = [e.get("event") for e in got]
+        assert kinds[0] == "status"         # snapshot first
+        batch = next(e for e in got if e.get("event") == "batch")
+        assert batch["rows"] == 1 and batch["seq"] == 1
+        assert got[-1].get("state") == "closed"   # terminal marker
+    finally:
+        srv.shutdown()
+
+
+def test_serve_stream_validation_cap_and_budget_pin(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("MRTPU_SERVE_STREAMS", "1")
+    srv = Server(port=0, workers=1, state_dir=str(tmp_path / "state"))
+    srv.start()
+    try:
+        c = ServeClient.local(srv.port)
+        from gpu_mapreduce_tpu.serve.client import ServeError
+        with pytest.raises(ServeError) as ei:
+            c.stream_open(parser="nope")
+        assert ei.value.code == 400
+        with pytest.raises(ServeError) as ei:
+            c.stream_open(reduce="cull")
+        assert ei.value.code == 400
+        stid = c.stream_open()["id"]
+        # the cap: a second OPEN stream is 429 + Retry-After
+        with pytest.raises(ServeError) as ei:
+            c.stream_open()
+        assert ei.value.code == 429
+        assert ei.value.retry_after is not None
+        # tenant budget defaults pinned the engine's spill into the
+        # stream's own scratch, not the daemon cwd
+        eng = srv.streams.get(stid).engine
+        assert eng.settings.get("fpath", "").startswith(
+            srv.streams.stream_dir(stid))
+        c.stream_close(stid)
+        # closing freed the cap slot
+        stid2 = c.stream_open()["id"]
+        assert stid2 != stid
+    finally:
+        srv.shutdown()
+
+
+def test_serve_stream_resumes_across_daemon_restart(tmp_path):
+    state = str(tmp_path / "state")
+    srv = Server(port=0, workers=1, state_dir=state)
+    srv.start()
+    c = ServeClient.local(srv.port)
+    stid = c.stream_open()["id"]
+    c.stream_feed(stid, b"x y x\n")
+    wait_until(lambda: c.stream_status(stid)["stream"]["batches"] >= 1,
+               msg="batch before restart")
+    srv.shutdown()          # suspends the stream, no stream_close
+    srv2 = Server(port=0, workers=1, state_dir=state)
+    srv2.start()
+    try:
+        c2 = ServeClient.local(srv2.port)
+        st = c2.stream_status(stid)
+        assert st["state"] == "open"
+        assert st["stream"]["batches"] == 1 and st["stream"]["resumed"]
+        c2.stream_feed(stid, b"z z\n")
+        wait_until(lambda: c2.stream_status(stid)["stream"]["batches"]
+                   >= 2, msg="post-restart batch")
+        out = c2.stream_close(stid)
+        assert out["state"] == "closed"
+        assert srv2.streams.get(stid).engine.snapshot() == \
+            oracle("x y x\nz z\n")
+        # a CLOSED stream stays closed on the next restart
+        srv2.shutdown()
+        srv3 = Server(port=0, workers=1, state_dir=state)
+        srv3.start()
+        assert srv3.streams.get(stid) is None
+        srv3.shutdown()
+    finally:
+        srv2.shutdown()     # idempotent
+
+
+def test_fleet_takeover_adopts_streams(tmp_path):
+    """A dead replica's standing queries move to the survivor: stream
+    directory copied, stream_open re-journaled under the claimant, the
+    engine resumed from the last committed cursor — and the final
+    snapshot is byte-identical to an uninterrupted run."""
+    root = str(tmp_path / "fleet")
+
+    def replica(rid, **kw):
+        return Server(port=0, workers=1, queue_cap=8, fleet_dir=root,
+                      replica_id=rid, lease_s=0.6, heartbeat_s=0.1,
+                      **kw)
+
+    a = replica("a")
+    b = replica("b")
+    a.start()
+    b.start()
+    try:
+        ca = ServeClient.local(a.port)
+        stid = ca.stream_open(tenant="acme")["id"]
+        assert stid.startswith("a.")
+        ca.stream_feed(stid, b"apple banana apple\ncherry\n")
+        wait_until(lambda: ca.stream_status(stid)["stream"]["batches"]
+                   >= 1, msg="batch on the original replica")
+        # kill -9 emulation: heartbeat stalls, listener stops, runner
+        # threads stop (a dead process has no threads), lease left on
+        # disk — serve/fleet failover discipline (tests/test_fleet.py)
+        a._fleet_suspended = True
+        a.streams.suspend_all()
+        if a._listener is not None:
+            a._listener.stop()
+        wait_until(lambda: b.streams.get(stid) is not None,
+                   timeout=60, msg="survivor adopting the stream")
+        ss = b.streams.get(stid)
+        assert ss.failed_over and ss.tenant == "acme"
+        wait_until(lambda: ss.engine is not None
+                   and ss.engine.status()["resumed"], msg="resume")
+        assert ss.engine.seq == 1        # committed state carried over
+        cb = ServeClient.local(b.port)
+        cb.stream_feed(stid, b"banana date\n")
+        wait_until(lambda: cb.stream_status(stid)["stream"]["batches"]
+                   >= 2, msg="post-takeover batch")
+        out = cb.stream_close(stid)
+        assert out["state"] == "closed"
+        assert b.streams.get(stid).engine.snapshot() == \
+            oracle("apple banana apple\ncherry\nbanana date\n")
+    finally:
+        b.shutdown()
+        a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the OINK surface
+# ---------------------------------------------------------------------------
+
+def test_oink_stream_command_family(tmp_path):
+    src = str(tmp_path / "in.txt")
+    with open(src, "w") as f:
+        f.write("a b a\nb c\n")
+    sdir = str(tmp_path / "st")
+    c = run_command("stream", ["open", sdir, src], screen=False)
+    assert "open" in c.result_msg
+    c = run_command("stream", ["poll", sdir], screen=False)
+    assert c.stream_status["rows"] == 2
+    with open(src, "a") as f:
+        f.write("c c d\n")
+    c = run_command("stream", ["poll", sdir], screen=False)
+    assert c.stream_status["rows"] == 3          # resumed + continued
+    out = str(tmp_path / "snap.txt")
+    run_command("stream", ["snapshot", sdir, out], screen=False)
+    with open(out) as f:
+        assert f.read() == oracle("a b a\nb c\nc c d\n")
+    c = run_command("stream", ["status", sdir], screen=False)
+    assert c.stream_status["state"] == "open"
+    c = run_command("stream", ["close", sdir], screen=False)
+    assert c.stream_status["state"] == "closed"
+    with pytest.raises(MRError):
+        run_command("stream", ["poll"], screen=False)   # usage
+    with pytest.raises(MRError):
+        run_command("stream", ["open", sdir], screen=False)
